@@ -1,0 +1,8 @@
+"""hbbft-tpu: TPU-native Honey Badger BFT framework.
+
+A ground-up rebuild of the capabilities of the Rust `hbbft` library
+(c0gent/hbbft) with a JAX/XLA/Pallas execution backend for the
+threshold-crypto inner loop.  See SURVEY.md for the reference analysis.
+"""
+
+__version__ = "0.1.0"

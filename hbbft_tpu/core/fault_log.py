@@ -1,0 +1,60 @@
+"""Fault evidence log (hbbft `src/fault_log.rs` §, unverified — SURVEY.md).
+
+Every protocol records *provable* misbehaviour by peers — an invalid Merkle
+proof, a second conflicting ``Value``, a decryption share that fails its
+pairing check — as a :class:`Fault` with a machine-readable kind string.  The
+log rides on every :class:`~hbbft_tpu.core.types.Step` and is the framework's
+failure-detection subsystem (SURVEY.md §5).
+
+Fault kinds are plain strings namespaced by protocol (``"broadcast:
+invalid_proof"``) rather than per-module enums: the set is open (new protocols
+add kinds freely) and strings serialize canonically.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Iterator, List
+
+
+@dataclass(frozen=True)
+class Fault:
+    """A single piece of evidence that ``node_id`` misbehaved."""
+
+    node_id: Any
+    kind: str
+
+    def __str__(self) -> str:  # pragma: no cover - debugging aid
+        return f"Fault({self.node_id!r}, {self.kind})"
+
+
+@dataclass
+class FaultLog:
+    """An append-only list of :class:`Fault` entries."""
+
+    entries: List[Fault] = field(default_factory=list)
+
+    @staticmethod
+    def init(node_id, kind: str) -> "FaultLog":
+        return FaultLog([Fault(node_id, kind)])
+
+    def append(self, fault: Fault) -> None:
+        self.entries.append(fault)
+
+    def report(self, node_id, kind: str) -> None:
+        self.entries.append(Fault(node_id, kind))
+
+    def extend(self, other: "FaultLog") -> None:
+        self.entries.extend(other.entries)
+
+    def kinds_for(self, node_id) -> List[str]:
+        return [f.kind for f in self.entries if f.node_id == node_id]
+
+    def __iter__(self) -> Iterator[Fault]:
+        return iter(self.entries)
+
+    def __len__(self) -> int:
+        return len(self.entries)
+
+    def __bool__(self) -> bool:
+        return bool(self.entries)

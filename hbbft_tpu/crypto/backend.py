@@ -1,0 +1,128 @@
+"""CryptoBackend — the north-star seam between protocols and device kernels.
+
+BASELINE.json's north star: "introduce a `CryptoBackend` trait behind the
+existing `DistAlgorithm` step boundary so that `threshold_sign`,
+`threshold_decrypt`, and the `binary_agreement` common coin hand their
+BLS12-381 pairing checks, multi-scalar-mults, and Lagrange share-combination
+to a batched device kernel".
+
+A backend bundles:
+
+* a :class:`~hbbft_tpu.crypto.group.Group` (the curve implementation),
+* key-material factories,
+* **batched** verify/combine entry points — the protocols and the VirtualNet
+  runtime only ever call these with *lists* of independent work items, so a
+  device backend can resolve a whole crank-round of pairing checks in one
+  dispatch (SURVEY.md §7 "deferred verification").
+
+Implementations:
+
+* :class:`MockBackend`   — MockGroup; replaces the reference's
+  `use-insecure-test-only-mock-crypto` Cargo feature (SURVEY.md §2.2).
+* :class:`CpuBackend`    — pure-Python BLS12-381 golden reference.
+* ``TpuBackend`` (hbbft_tpu/ops/backend.py) — JAX batched kernels.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from hbbft_tpu.crypto.group import Group, MockGroup
+from hbbft_tpu.crypto.keys import (
+    Ciphertext,
+    DecryptionShare,
+    PublicKeySet,
+    PublicKeyShare,
+    SecretKey,
+    SecretKeySet,
+    Signature,
+    SignatureShare,
+)
+
+
+class CryptoBackend(abc.ABC):
+    """Factory + batched crypto operations over one group backend."""
+
+    def __init__(self, group: Group) -> None:
+        self.group = group
+
+    # -- key material --------------------------------------------------------
+
+    def generate_key_set(self, threshold: int, rng) -> SecretKeySet:
+        return SecretKeySet.random(self.group, threshold, rng)
+
+    def generate_secret_key(self, rng) -> SecretKey:
+        return SecretKey.random(self.group, rng)
+
+    # -- batched verification (the hot loop; SURVEY.md §3.2) -----------------
+
+    def verify_sig_shares(
+        self, items: Sequence[Tuple[PublicKeyShare, bytes, SignatureShare]]
+    ) -> List[bool]:
+        """Verify a batch of (pk_share, document, sig_share) triples."""
+        out = []
+        for pk, doc, share in items:
+            out.append(pk.verify_sig_share(share, doc))
+        return out
+
+    def verify_dec_shares(
+        self, items: Sequence[Tuple[PublicKeyShare, Ciphertext, DecryptionShare]]
+    ) -> List[bool]:
+        """Verify a batch of (pk_share, ciphertext, dec_share) triples."""
+        out = []
+        for pk, ct, share in items:
+            out.append(pk.verify_decryption_share(share, ct))
+        return out
+
+    def verify_signatures(
+        self, items: Sequence[Tuple[Any, bytes, Signature]]
+    ) -> List[bool]:
+        """Verify a batch of full (public_key, message, signature) triples
+        (per-node vote/key-gen signatures — SURVEY.md §3.2 DHB path)."""
+        return [pk.verify(sig, msg) for pk, msg, sig in items]
+
+    def verify_ciphertexts(self, items: Sequence[Ciphertext]) -> List[bool]:
+        return [ct.verify() for ct in items]
+
+    # -- combination ---------------------------------------------------------
+
+    def combine_signatures(
+        self, pk_set: PublicKeySet, shares: Dict[int, SignatureShare]
+    ) -> Signature:
+        return pk_set.combine_signatures(shares)
+
+    def combine_decryption_shares(
+        self, pk_set: PublicKeySet, shares: Dict[int, DecryptionShare], ct: Ciphertext
+    ) -> bytes:
+        return pk_set.combine_decryption_shares(shares, ct)
+
+    # -- misc ----------------------------------------------------------------
+
+    @property
+    def name(self) -> str:
+        return type(self).__name__
+
+    def flush(self) -> None:
+        """Device backends override to force pending batches to resolve."""
+
+
+class MockBackend(CryptoBackend):
+    """Fast insecure backend for protocol-logic tests (mock bilinear group)."""
+
+    def __init__(self) -> None:
+        super().__init__(MockGroup())
+
+
+class CpuBackend(CryptoBackend):
+    """Pure-Python BLS12-381 — the golden reference backend.
+
+    Slow (Python-int pairings) but real: used to golden-test both the
+    protocol layer and the JAX kernels.  Imported lazily to keep MockBackend
+    import-light.
+    """
+
+    def __init__(self) -> None:
+        from hbbft_tpu.crypto.bls381 import BLS381Group
+
+        super().__init__(BLS381Group())

@@ -1,0 +1,128 @@
+"""SHA-256 Merkle tree over erasure-coded shards.
+
+Replaces the reference's `src/broadcast/merkle.rs` § (SURVEY.md §2.1): the
+proposer commits to the shard vector with a Merkle root; each `Value`/`Echo`
+carries a shard plus its inclusion proof, so receivers can attribute a bad
+shard to the proposer (FaultLog evidence) before reconstruction.
+
+Host implementation uses hashlib; the batched device path (verify O(N²)
+Echo proofs per epoch) lives in hbbft_tpu/ops/ and is profile-gated —
+SURVEY.md §2.2 notes Merkle verify is not the dominant cost.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Tuple
+
+
+def _h_leaf(data: bytes) -> bytes:
+    return hashlib.sha256(b"\x00" + data).digest()
+
+
+def _h_node(left: bytes, right: bytes) -> bytes:
+    return hashlib.sha256(b"\x01" + left + right).digest()
+
+
+@dataclass(frozen=True)
+class Proof:
+    """Inclusion proof: a leaf value, its index, the sibling path, the root.
+
+    Mirrors `merkle::Proof` § — carried inside Broadcast `Value`/`Echo`
+    messages.
+    """
+
+    value: bytes
+    index: int
+    path: Tuple[bytes, ...]
+    root_hash: bytes
+    n_leaves: int
+
+    def validate(self, n_leaves: int) -> bool:
+        """Check the proof against its own root for a tree of ``n_leaves``."""
+        if n_leaves != self.n_leaves or not 0 <= self.index < n_leaves:
+            return False
+        if len(self.path) != _depth(n_leaves):
+            return False
+        acc = _h_leaf(self.value)
+        idx = self.index
+        for sib in self.path:
+            acc = _h_node(acc, sib) if idx % 2 == 0 else _h_node(sib, acc)
+            idx //= 2
+        return acc == self.root_hash
+
+    def to_bytes(self) -> bytes:
+        out = [
+            self.index.to_bytes(2, "big"),
+            self.n_leaves.to_bytes(2, "big"),
+            self.root_hash,
+            len(self.path).to_bytes(1, "big"),
+            b"".join(self.path),
+            len(self.value).to_bytes(4, "big"),
+            self.value,
+        ]
+        return b"".join(out)
+
+    @staticmethod
+    def from_bytes(data: bytes) -> "Proof":
+        index = int.from_bytes(data[0:2], "big")
+        n_leaves = int.from_bytes(data[2:4], "big")
+        root = data[4:36]
+        plen = data[36]
+        path = tuple(data[37 + i * 32 : 37 + (i + 1) * 32] for i in range(plen))
+        off = 37 + plen * 32
+        vlen = int.from_bytes(data[off : off + 4], "big")
+        value = data[off + 4 : off + 4 + vlen]
+        return Proof(value, index, path, root, n_leaves)
+
+
+def _depth(n_leaves: int) -> int:
+    d = 0
+    size = 1
+    while size < n_leaves:
+        size *= 2
+        d += 1
+    return d
+
+
+class MerkleTree:
+    """Merkle tree over a shard vector, padded to a power of two with empty
+    leaves (distinct from real leaves via the 0x00/0x01 domain tags)."""
+
+    def __init__(self, leaves: Sequence[bytes]) -> None:
+        if not leaves:
+            raise ValueError("empty tree")
+        self.leaves = list(leaves)
+        n = len(leaves)
+        size = 1 << _depth(n)
+        level = [_h_leaf(v) for v in self.leaves] + [
+            _h_leaf(b"") for _ in range(size - n)
+        ]
+        self.levels: List[List[bytes]] = [level]
+        while len(level) > 1:
+            level = [
+                _h_node(level[i], level[i + 1]) for i in range(0, len(level), 2)
+            ]
+            self.levels.append(level)
+
+    @property
+    def root_hash(self) -> bytes:
+        return self.levels[-1][0]
+
+    def proof(self, index: int) -> Proof:
+        if not 0 <= index < len(self.leaves):
+            raise IndexError(index)
+        path = []
+        idx = index
+        for level in self.levels[:-1]:
+            sib = idx ^ 1
+            path.append(level[sib])
+            idx //= 2
+        return Proof(
+            value=self.leaves[index],
+            index=index,
+            path=tuple(path),
+            root_hash=self.root_hash,
+            n_leaves=len(self.leaves),
+        )

@@ -1,0 +1,97 @@
+"""Adversary framework for the VirtualNet simulator.
+
+Rebuild of `tests/net/adversary.rs` § (SURVEY.md §2.1): an adversary gets two
+hooks — ``pre_crank`` (observe/reorder/inject before each delivery) and
+``tamper`` (rewrite traffic originating from faulty nodes).  Used by every
+protocol integration test to exercise Byzantine scheduling and corruption.
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING, Callable, List, Optional
+
+if TYPE_CHECKING:  # pragma: no cover
+    from hbbft_tpu.net.virtual_net import NetMessage, VirtualNet
+
+
+class Adversary:
+    """Default: passive (deliver in scheduler order, no tampering).
+
+    ``scheduler_override``: adversaries whose power *is* delivery order set
+    this to ``"first"`` so VirtualNet pops the queue head they arranged in
+    ``pre_crank`` (the default random scheduler would erase the ordering).
+    """
+
+    scheduler_override: Optional[str] = None
+
+    def pre_crank(self, net: "VirtualNet") -> None:
+        """Called before each crank; may reorder/inject into ``net.queue``."""
+
+    def tamper(self, net: "VirtualNet", msg: "NetMessage") -> List["NetMessage"]:
+        """Rewrite a message sent by a *faulty* node.  Return the (possibly
+        empty, possibly longer) list of messages to enqueue instead."""
+        return [msg]
+
+
+class NullAdversary(Adversary):
+    pass
+
+
+class NodeOrderAdversary(Adversary):
+    """Delivers messages grouped by recipient id order — a scheduling game
+    that starves late nodes (reference `NodeOrderAdversary` §)."""
+
+    scheduler_override = "first"
+
+    def pre_crank(self, net: "VirtualNet") -> None:
+        if net.queue:
+            net.queue.sort(key=lambda m: net.node_order_key(m.to))
+
+
+class ReorderingAdversary(Adversary):
+    """Randomly shuffles the pending queue every crank (seeded)."""
+
+    scheduler_override = "first"
+
+    def pre_crank(self, net: "VirtualNet") -> None:
+        net.rng.shuffle(net.queue)
+
+
+class SilentAdversary(Adversary):
+    """Faulty nodes never send anything (crash-style faults)."""
+
+    def tamper(self, net: "VirtualNet", msg: "NetMessage") -> List["NetMessage"]:
+        return []
+
+
+class RandomAdversary(Adversary):
+    """Replaces faulty nodes' traffic with random well-typed messages.
+
+    ``generator(net, msg)`` produces a replacement payload; with probability
+    ``p_replace`` the original message is swapped, otherwise passed through.
+    (Reference `RandomAdversary` § generates random well-typed messages via
+    proptest strategies; here the per-protocol test supplies the generator.)
+    """
+
+    def __init__(
+        self,
+        generator: Callable[["VirtualNet", "NetMessage"], object],
+        p_replace: float = 0.5,
+        p_drop: float = 0.0,
+    ) -> None:
+        self.generator = generator
+        self.p_replace = p_replace
+        self.p_drop = p_drop
+
+    def tamper(self, net: "VirtualNet", msg: "NetMessage") -> List["NetMessage"]:
+        from hbbft_tpu.net.virtual_net import NetMessage
+
+        roll = net.rng.random()
+        if roll < self.p_drop:
+            return []
+        if roll < self.p_drop + self.p_replace:
+            payload = self.generator(net, msg)
+            if payload is None:
+                return []
+            return [NetMessage(msg.sender, msg.to, payload)]
+        return [msg]

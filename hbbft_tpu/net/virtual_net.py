@@ -1,0 +1,322 @@
+"""VirtualNet — deterministic single-process network simulator and runtime.
+
+Rebuild of the reference's `tests/net/` harness (`VirtualNet`, `NetBuilder`,
+`CrankError` §, SURVEY.md §2.1/§3.3), promoted from test utility to **the**
+framework runtime: on TPU, this driver is also the crypto-batch accumulator —
+deferred :class:`~hbbft_tpu.core.types.CryptoWork` items emitted by protocol
+steps are resolved either immediately (``defer_mode="eager"``, reference
+semantics) or accumulated across a whole crank round and resolved in one
+batched device call (``defer_mode="round"`` — the SURVEY.md §7 round-barrier
+design that makes the N=100 pairing load a single dispatch).
+
+Everything is seeded and deterministic: one `random.Random` threaded through
+scheduling, adversaries, and protocol RNG needs.
+"""
+
+from __future__ import annotations
+
+import random
+from collections import defaultdict
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from hbbft_tpu.core.network_info import NetworkInfo
+from hbbft_tpu.core.types import CryptoWork, Step, TargetedMessage
+from hbbft_tpu.crypto.backend import CryptoBackend, MockBackend
+from hbbft_tpu.net.adversary import Adversary, NullAdversary
+
+
+class CrankError(Exception):
+    """Limit exceeded or invariant broken while cranking."""
+
+
+@dataclass
+class NetMessage:
+    """An in-flight message (reference `NetMessage` §)."""
+
+    sender: Any
+    to: Any
+    payload: Any
+
+
+@dataclass
+class Node:
+    """One simulated node: its algorithm instance and captured outputs."""
+
+    id: Any
+    algorithm: Any
+    faulty: bool = False
+    outputs: List[Any] = field(default_factory=list)
+    faults_observed: List[Any] = field(default_factory=list)
+
+
+class VirtualNet:
+    """N protocol instances + a message queue + a crank loop."""
+
+    def __init__(
+        self,
+        nodes: Dict[Any, Node],
+        backend: CryptoBackend,
+        adversary: Adversary,
+        rng: random.Random,
+        message_limit: Optional[int] = None,
+        crank_limit: Optional[int] = None,
+        defer_mode: str = "eager",
+        scheduler: str = "random",
+    ) -> None:
+        self.nodes = nodes
+        self.backend = backend
+        self.adversary = adversary
+        self.rng = rng
+        self.queue: List[NetMessage] = []
+        self.message_limit = message_limit
+        self.crank_limit = crank_limit
+        self.defer_mode = defer_mode
+        self.scheduler = scheduler
+        self.messages_delivered = 0
+        self.dropped_messages = 0
+        self.cranks = 0
+        self._node_order = {n: i for i, n in enumerate(sorted(nodes))}
+        self._pending_work: List[CryptoWork] = []
+
+    # -- introspection -------------------------------------------------------
+
+    def node_order_key(self, node_id) -> int:
+        return self._node_order.get(node_id, len(self._node_order))
+
+    def correct_nodes(self) -> List[Node]:
+        return [n for n in self.nodes.values() if not n.faulty]
+
+    def faulty_nodes(self) -> List[Node]:
+        return [n for n in self.nodes.values() if n.faulty]
+
+    def node(self, node_id) -> Node:
+        return self.nodes[node_id]
+
+    # -- input ---------------------------------------------------------------
+
+    def send_input(self, node_id, input: Any) -> Step:
+        node = self.nodes[node_id]
+        step = node.algorithm.handle_input(input, rng=self.rng)
+        self._process_step(node, step)
+        return step
+
+    def broadcast_input(self, input: Any) -> None:
+        for node_id in sorted(self.nodes):
+            self.send_input(node_id, input)
+
+    # -- cranking ------------------------------------------------------------
+
+    def crank(self) -> Optional[Tuple[Any, Step]]:
+        """Deliver one message.  Returns (recipient, step) or None if idle."""
+        self.adversary.pre_crank(self)
+        if not self.queue:
+            self._flush_work()
+            if not self.queue:
+                return None
+        self.cranks += 1
+        if self.crank_limit is not None and self.cranks > self.crank_limit:
+            raise CrankError(f"crank limit {self.crank_limit} exceeded")
+
+        scheduler = self.adversary.scheduler_override or self.scheduler
+        idx = self.rng.randrange(len(self.queue)) if scheduler == "random" else 0
+        msg = self.queue.pop(idx)
+        node = self.nodes.get(msg.to)
+        if node is None:
+            # Addressed to a node this net doesn't model (e.g. departed
+            # validator): count it so lost traffic is diagnosable.
+            self.dropped_messages += 1
+            return msg.to, Step()
+        self.messages_delivered += 1
+        if self.message_limit is not None and self.messages_delivered > self.message_limit:
+            raise CrankError(f"message limit {self.message_limit} exceeded")
+        step = node.algorithm.handle_message(msg.sender, msg.payload, rng=self.rng)
+        self._process_step(node, step)
+        return msg.to, step
+
+    def crank_round(self) -> int:
+        """Deliver every currently-queued message, then resolve all deferred
+        crypto in one batched backend call (the TPU round barrier).  Returns
+        number of messages delivered."""
+        n = len(self.queue)
+        delivered = 0
+        for _ in range(n):
+            if not self.queue:
+                break
+            if self.crank() is not None:
+                delivered += 1
+        self._flush_work()
+        return delivered
+
+    def crank_until(
+        self,
+        pred: Callable[["VirtualNet"], bool],
+        max_cranks: int = 100_000,
+    ) -> None:
+        """Crank until ``pred(net)`` or quiescence; CrankError on starvation."""
+        for _ in range(max_cranks):
+            if pred(self):
+                return
+            if self.crank() is None:
+                self._flush_work()
+                if not self.queue:
+                    if pred(self):
+                        return
+                    raise CrankError("network quiesced before predicate held")
+        raise CrankError(f"predicate not reached in {max_cranks} cranks")
+
+    def crank_to_quiescence(self, max_cranks: int = 1_000_000) -> None:
+        for _ in range(max_cranks):
+            if self.crank() is None:
+                self._flush_work()
+                if not self.queue:
+                    return
+        raise CrankError("not quiescent")
+
+    # -- step processing -----------------------------------------------------
+
+    def _process_step(self, node: Node, step: Step) -> None:
+        node.outputs.extend(step.output)
+        node.faults_observed.extend(step.fault_log)
+        for work in step.work:
+            if work.owner is None:
+                work.owner = node.id
+            if self.defer_mode == "eager":
+                self._resolve_work([work])
+            else:
+                self._pending_work.append(work)
+        for tm in step.messages:
+            self._route(node, tm)
+
+    def _route(self, node: Node, tm: TargetedMessage) -> None:
+        recipients = tm.target.recipients(sorted(self.nodes), our_id=node.id)
+        for to in recipients:
+            msg = NetMessage(node.id, to, tm.message)
+            if node.faulty:
+                for m in self.adversary.tamper(self, msg):
+                    self.queue.append(m)
+            else:
+                self.queue.append(msg)
+
+    # -- deferred crypto -----------------------------------------------------
+
+    def _flush_work(self) -> None:
+        while self._pending_work:
+            batch, self._pending_work = self._pending_work, []
+            self._resolve_work(batch)
+
+    def _resolve_work(self, batch: Sequence[CryptoWork]) -> None:
+        """Group work items by kind, hit the backend once per kind, re-enter
+        the protocol callbacks, and process any follow-up steps."""
+        by_kind: Dict[str, List[CryptoWork]] = defaultdict(list)
+        for w in batch:
+            by_kind[w.kind].append(w)
+        follow_ups: List[Tuple[CryptoWork, Any]] = []
+        for kind, items in by_kind.items():
+            if kind == "verify_sig_share":
+                results = self.backend.verify_sig_shares([w.payload for w in items])
+            elif kind == "verify_dec_share":
+                results = self.backend.verify_dec_shares([w.payload for w in items])
+            elif kind == "verify_signature":
+                results = self.backend.verify_signatures([w.payload for w in items])
+            elif kind == "verify_ciphertext":
+                results = self.backend.verify_ciphertexts([w.payload for w in items])
+            else:
+                raise CrankError(f"unknown crypto work kind {kind!r}")
+            follow_ups.extend(zip(items, results))
+        for work, result in follow_ups:
+            follow_step = work.on_result(result)
+            if follow_step:
+                owner = self.nodes.get(work.owner)
+                if owner is None:
+                    raise CrankError("crypto work item has no owner node")
+                self._process_step(owner, follow_step)
+
+
+class NetBuilder:
+    """Fluent builder mirroring the reference `NetBuilder` §.
+
+    Example::
+
+        net = (NetBuilder(range(4))
+               .num_faulty(1)
+               .backend(MockBackend())
+               .using(lambda netinfo, b: ThresholdSign(netinfo, b, doc=b"x"))
+               .build(seed=7))
+    """
+
+    def __init__(self, node_ids: Sequence[Any]) -> None:
+        self._ids = sorted(node_ids)
+        self._num_faulty = 0
+        self._adversary: Adversary = NullAdversary()
+        self._backend: Optional[CryptoBackend] = None
+        self._message_limit: Optional[int] = None
+        self._crank_limit: Optional[int] = None
+        self._defer_mode = "eager"
+        self._scheduler = "random"
+        self._constructor: Optional[Callable[[NetworkInfo, CryptoBackend], Any]] = None
+
+    def num_faulty(self, f: int) -> "NetBuilder":
+        if len(self._ids) <= 3 * f and f > 0:
+            raise ValueError(f"N={len(self._ids)} cannot tolerate f={f} (need N>3f)")
+        self._num_faulty = f
+        return self
+
+    def adversary(self, adv: Adversary) -> "NetBuilder":
+        self._adversary = adv
+        return self
+
+    def backend(self, backend: CryptoBackend) -> "NetBuilder":
+        self._backend = backend
+        return self
+
+    def message_limit(self, limit: int) -> "NetBuilder":
+        self._message_limit = limit
+        return self
+
+    def crank_limit(self, limit: int) -> "NetBuilder":
+        self._crank_limit = limit
+        return self
+
+    def defer_mode(self, mode: str) -> "NetBuilder":
+        assert mode in ("eager", "round")
+        self._defer_mode = mode
+        return self
+
+    def scheduler(self, mode: str) -> "NetBuilder":
+        assert mode in ("random", "first")
+        self._scheduler = mode
+        return self
+
+    def using(
+        self, constructor: Callable[[NetworkInfo, CryptoBackend], Any]
+    ) -> "NetBuilder":
+        """``constructor(netinfo, backend) -> protocol instance`` per node."""
+        self._constructor = constructor
+        return self
+
+    def build(self, seed: int = 0) -> VirtualNet:
+        if self._constructor is None:
+            raise ValueError("NetBuilder.using(...) not set")
+        rng = random.Random(seed)
+        backend = self._backend or MockBackend()
+        netinfos = NetworkInfo.generate_map(self._ids, rng, backend)
+        faulty_ids = set(rng.sample(self._ids, self._num_faulty))
+        nodes = {
+            nid: Node(
+                id=nid,
+                algorithm=self._constructor(netinfos[nid], backend),
+                faulty=nid in faulty_ids,
+            )
+            for nid in self._ids
+        }
+        return VirtualNet(
+            nodes=nodes,
+            backend=backend,
+            adversary=self._adversary,
+            rng=rng,
+            message_limit=self._message_limit,
+            crank_limit=self._crank_limit,
+            defer_mode=self._defer_mode,
+            scheduler=self._scheduler,
+        )

@@ -1,0 +1,88 @@
+"""BinaryAgreement integration tests (reference `tests/binary_agreement.rs` §).
+
+All correct nodes must decide the same bit; if all correct nodes propose the
+same value, that value is decided (validity).  Exercised under reordering and
+silent-fault adversaries, in eager and round-batched crypto modes.
+"""
+
+import pytest
+
+from hbbft_tpu.net.adversary import NodeOrderAdversary, ReorderingAdversary, SilentAdversary
+from hbbft_tpu.net.virtual_net import NetBuilder
+from hbbft_tpu.protocols.binary_agreement import BinaryAgreement
+
+
+def build(n, f=0, adversary=None, defer_mode="eager", seed=0):
+    b = (
+        NetBuilder(range(n))
+        .num_faulty(f)
+        .defer_mode(defer_mode)
+        .crank_limit(200_000)
+        .using(lambda ni, be: BinaryAgreement(ni, be, session_id=b"test-ba"))
+    )
+    if adversary:
+        b = b.adversary(adversary)
+    return b.build(seed=seed)
+
+
+def decisions(net):
+    return {node.id: node.outputs for node in net.correct_nodes()}
+
+
+def assert_agreement(net, expected=None):
+    ds = decisions(net)
+    assert all(len(v) == 1 for v in ds.values()), f"outputs: {ds}"
+    vals = {v[0] for v in ds.values()}
+    assert len(vals) == 1, f"disagreement: {ds}"
+    if expected is not None:
+        assert vals == {expected}
+
+
+@pytest.mark.parametrize("n", [1, 2, 4])
+@pytest.mark.parametrize("value", [True, False])
+def test_unanimous_input_decides_that_value(n, value):
+    net = build(n)
+    net.broadcast_input(value)
+    net.crank_to_quiescence()
+    assert_agreement(net, expected=value)
+
+
+@pytest.mark.parametrize("seed", range(6))
+@pytest.mark.parametrize("defer_mode", ["eager", "round"])
+def test_mixed_inputs_agree(seed, defer_mode):
+    net = build(4, f=1, defer_mode=defer_mode, seed=seed)
+    for i in sorted(net.nodes):
+        net.send_input(i, i % 2 == 0)
+    if defer_mode == "round":
+        while net.queue or net._pending_work:
+            net.crank_round()
+    else:
+        net.crank_to_quiescence()
+    assert_agreement(net)
+
+
+@pytest.mark.parametrize("adversary_cls", [ReorderingAdversary, NodeOrderAdversary])
+@pytest.mark.parametrize("seed", range(4))
+def test_adversarial_scheduling(adversary_cls, seed):
+    net = build(7, f=2, adversary=adversary_cls(), seed=seed)
+    for i in sorted(net.nodes):
+        net.send_input(i, i % 3 == 0)
+    net.crank_to_quiescence()
+    assert_agreement(net)
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_silent_faulty_minority(seed):
+    net = build(7, f=2, adversary=SilentAdversary(), seed=seed)
+    for i in sorted(net.nodes):
+        net.send_input(i, i % 2 == 1)
+    net.crank_to_quiescence()
+    assert_agreement(net)
+
+
+def test_larger_net():
+    net = build(10, f=3, adversary=ReorderingAdversary(), seed=13)
+    for i in sorted(net.nodes):
+        net.send_input(i, i < 5)
+    net.crank_to_quiescence()
+    assert_agreement(net)

@@ -1,0 +1,251 @@
+"""Core contract tests: canonical serde, Target routing, Step combinators,
+erasure coding, Merkle proofs, and the mock threshold-crypto layer."""
+
+import random
+
+import pytest
+
+from hbbft_tpu.core.fault_log import Fault, FaultLog
+from hbbft_tpu.core.network_info import NetworkInfo
+from hbbft_tpu.core.types import Step, Target, TargetedMessage, absorb_child_step
+from hbbft_tpu.crypto.backend import MockBackend
+from hbbft_tpu.crypto.erasure import RSCodec, gf256
+from hbbft_tpu.crypto.group import MockGroup
+from hbbft_tpu.crypto.keys import SecretKey, SecretKeySet
+from hbbft_tpu.crypto.merkle import MerkleTree, Proof
+from hbbft_tpu.crypto.poly import BivarPoly, Poly
+from hbbft_tpu.utils import canonical
+
+
+# ---------------------------------------------------------------------------
+# canonical serde
+# ---------------------------------------------------------------------------
+
+
+def test_canonical_roundtrip():
+    objs = [
+        None,
+        True,
+        False,
+        0,
+        -1,
+        2**400,
+        -(2**400),
+        b"",
+        b"\x00\xff",
+        "héllo",
+        [1, [2, 3]],
+        (1, b"x", None),
+        {"b": 1, "a": [True]},
+        {(1, 2): "t"},
+    ]
+    for o in objs:
+        assert canonical.decode(canonical.encode(o)) == o
+
+
+def test_canonical_dict_order_independent():
+    a = canonical.encode({"x": 1, "y": 2})
+    b = canonical.encode(dict([("y", 2), ("x", 1)]))
+    assert a == b
+
+
+def test_canonical_distinguishes_types():
+    assert canonical.encode(0) != canonical.encode(False)
+    assert canonical.encode([1]) != canonical.encode((1,))
+    assert canonical.encode("a") != canonical.encode(b"a")
+
+
+# ---------------------------------------------------------------------------
+# Target / Step
+# ---------------------------------------------------------------------------
+
+
+def test_target_routing():
+    ids = [0, 1, 2, 3]
+    assert Target.all().recipients(ids, our_id=1) == [0, 2, 3]
+    assert Target.node(2).recipients(ids, our_id=1) == [2]
+    assert sorted(Target.nodes([0, 3]).recipients(ids, our_id=0)) == [3]
+    assert sorted(Target.all_except([2]).recipients(ids, our_id=1)) == [0, 3]
+
+
+def test_step_extend_and_absorb():
+    s1 = Step.from_output("a")
+    s2 = Step.from_msg(Target.all(), "m").add_fault(7, "k")
+    s1.extend(s2)
+    assert s1.output == ["a"] and len(s1.messages) == 1 and len(s1.fault_log) == 1
+
+    child = Step.from_output(10)
+    child.messages.append(TargetedMessage(Target.node(1), "inner"))
+    parent = absorb_child_step(
+        child,
+        wrap_msg=lambda m: ("wrapped", m),
+        on_output=lambda o: Step.from_output(o * 2),
+    )
+    assert parent.output == [20]
+    assert parent.messages[0].message == ("wrapped", "inner")
+
+
+# ---------------------------------------------------------------------------
+# GF(2^8) + Reed-Solomon
+# ---------------------------------------------------------------------------
+
+
+def test_gf256_field_axioms():
+    import numpy as np
+
+    gf = gf256()
+    rng = np.random.default_rng(0)
+    a = rng.integers(0, 256, 100).astype(np.uint8)
+    b = rng.integers(0, 256, 100).astype(np.uint8)
+    c = rng.integers(0, 256, 100).astype(np.uint8)
+    # commutativity, associativity, distributivity over XOR
+    assert (gf.mul(a, b) == gf.mul(b, a)).all()
+    assert (gf.mul(gf.mul(a, b), c) == gf.mul(a, gf.mul(b, c))).all()
+    assert (gf.mul(a, b ^ c) == (gf.mul(a, b) ^ gf.mul(a, c))).all()
+    # inverses
+    for x in range(1, 256):
+        assert int(gf.mul(x, gf.inv(x))) == 1
+
+
+@pytest.mark.parametrize("k,m", [(1, 0), (2, 1), (2, 2), (4, 2), (6, 4), (10, 22)])
+def test_rs_roundtrip(k, m):
+    rng = random.Random(42)
+    codec = RSCodec(k, m)
+    data = bytes(rng.randrange(256) for _ in range(137))
+    shards = codec.encode(data)
+    assert len(shards) == k + m
+    # Drop any m shards; reconstruct.
+    lost = rng.sample(range(k + m), m)
+    partial = [None if i in lost else s for i, s in enumerate(shards)]
+    assert codec.decode_data(partial, len(data)) == data
+    full = codec.reconstruct(partial)
+    assert full == shards
+
+
+def test_rs_insufficient_shards():
+    codec = RSCodec(4, 2)
+    shards = codec.encode(b"hello world")
+    partial = [shards[0], None, None, shards[3], None, None]
+    with pytest.raises(ValueError):
+        codec.reconstruct(partial)
+
+
+# ---------------------------------------------------------------------------
+# Merkle
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("n", [1, 2, 3, 4, 5, 7, 8, 13])
+def test_merkle_proofs(n):
+    leaves = [bytes([i]) * (i + 1) for i in range(n)]
+    tree = MerkleTree(leaves)
+    for i in range(n):
+        p = tree.proof(i)
+        assert p.validate(n)
+        assert Proof.from_bytes(p.to_bytes()) == p
+    # Tampered value fails.
+    p = tree.proof(0)
+    bad = Proof(b"evil", p.index, p.path, p.root_hash, p.n_leaves)
+    assert not bad.validate(n)
+
+
+# ---------------------------------------------------------------------------
+# Mock threshold crypto
+# ---------------------------------------------------------------------------
+
+
+def test_mock_bls_signature():
+    g = MockGroup()
+    rng = random.Random(1)
+    sk = SecretKey.random(g, rng)
+    pk = sk.public_key()
+    sig = sk.sign(b"hello")
+    assert pk.verify(sig, b"hello")
+    assert not pk.verify(sig, b"other")
+
+
+def test_threshold_signature_combine():
+    g = MockGroup()
+    rng = random.Random(2)
+    sk_set = SecretKeySet.random(g, threshold=2, rng=rng)
+    pk_set = sk_set.public_keys()
+    doc = b"the document"
+    shares = {}
+    for i in range(7):
+        share = sk_set.secret_key_share(i).sign_share(doc)
+        assert pk_set.public_key_share(i).verify_sig_share(share, doc)
+        shares[i] = share
+    # Any 3 shares combine to the same signature, which verifies under master.
+    sig_a = pk_set.combine_signatures({i: shares[i] for i in [0, 1, 2]})
+    sig_b = pk_set.combine_signatures({i: shares[i] for i in [3, 5, 6]})
+    assert sig_a == sig_b
+    assert pk_set.public_key().verify(sig_a, doc)
+    # Wrong share fails verification.
+    bad = sk_set.secret_key_share(0).sign_share(b"oops")
+    assert not pk_set.public_key_share(1).verify_sig_share(bad, doc)
+
+
+def test_threshold_encryption():
+    g = MockGroup()
+    rng = random.Random(3)
+    sk_set = SecretKeySet.random(g, threshold=1, rng=rng)
+    pk_set = sk_set.public_keys()
+    msg = b"secret payload !"
+    ct = pk_set.encrypt(msg, rng)
+    assert ct.verify()
+    shares = {}
+    for i in [0, 2]:
+        d = sk_set.secret_key_share(i).decrypt_share(ct)
+        assert pk_set.public_key_share(i).verify_decryption_share(d, ct)
+        shares[i] = d
+    assert pk_set.combine_decryption_shares(shares, ct) == msg
+    # A share for a different ciphertext fails.
+    ct2 = pk_set.encrypt(b"another message!", rng)
+    d_bad = sk_set.secret_key_share(0).decrypt_share(ct2)
+    assert not pk_set.public_key_share(0).verify_decryption_share(d_bad, ct)
+
+
+def test_plain_encryption_roundtrip():
+    g = MockGroup()
+    rng = random.Random(4)
+    sk = SecretKey.random(g, rng)
+    ct = sk.public_key().encrypt(b"dkg row bytes", rng)
+    assert sk.decrypt(ct) == b"dkg row bytes"
+
+
+def test_poly_and_bivar():
+    g = MockGroup()
+    rng = random.Random(5)
+    p = Poly.random(g, 3, rng)
+    c = p.commitment()
+    for x in [0, 1, 5, 1234]:
+        assert c.evaluate(x) == g.g1_mul(p.evaluate(x), g.g1())
+    b = BivarPoly.random(g, 2, rng)
+    bc = b.commitment()
+    # symmetry
+    assert b.evaluate(3, 8) == b.evaluate(8, 3)
+    # row consistency
+    row2 = b.row(2)
+    assert row2.evaluate(5) == b.evaluate(2, 5)
+    assert bc.row(2).evaluate(5) == g.g1_mul(b.evaluate(2, 5), g.g1())
+    # commitment eval matches
+    assert bc.evaluate(4, 9) == g.g1_mul(b.evaluate(4, 9), g.g1())
+
+
+def test_network_info_generate_map():
+    rng = random.Random(6)
+    infos = NetworkInfo.generate_map(list(range(4)), rng, MockBackend())
+    assert len(infos) == 4
+    ni = infos[0]
+    assert ni.num_nodes() == 4 and ni.num_faulty() == 1 and ni.num_correct() == 3
+    assert ni.is_validator()
+    # Same master public key everywhere.
+    pks = {i: infos[i].public_key_set for i in range(4)}
+    assert all(pks[i] == pks[0] for i in range(4))
+    # Share i signs; master key verifies combined.
+    doc = b"x"
+    shares = {
+        i: infos[i].secret_key_share.sign_share(doc) for i in range(2)
+    }
+    sig = pks[0].combine_signatures(shares)
+    assert pks[0].public_key().verify(sig, doc)
