@@ -76,7 +76,8 @@ class VirtualNet:
         self.messages_delivered = 0
         self.dropped_messages = 0
         self.cranks = 0
-        self._node_order = {n: i for i, n in enumerate(sorted(nodes))}
+        self._sorted_ids = sorted(nodes)
+        self._node_order = {n: i for i, n in enumerate(self._sorted_ids)}
         self._pending_work: List[CryptoWork] = []
 
     # -- introspection -------------------------------------------------------
@@ -189,7 +190,7 @@ class VirtualNet:
             self._route(node, tm)
 
     def _route(self, node: Node, tm: TargetedMessage) -> None:
-        recipients = tm.target.recipients(sorted(self.nodes), our_id=node.id)
+        recipients = tm.target.recipients(self._sorted_ids, our_id=node.id)
         for to in recipients:
             msg = NetMessage(node.id, to, tm.message)
             if node.faulty:

@@ -45,7 +45,9 @@ class SbvBroadcast:
         self.netinfo = netinfo
         self.received_bval = BoolMultimap()
         self.sent_bval = BoolSet.none()
-        self.received_aux = BoolMultimap()
+        # Aux is one-per-sender: keyed by sender so a Byzantine node cannot
+        # count twice toward the N-f quorum by sending both values.
+        self.received_aux: dict = {}
         self.sent_aux = False
         self.bin_values = BoolSet.none()
         self.output: Optional[BoolSet] = None
@@ -96,21 +98,21 @@ class SbvBroadcast:
     # -- Aux -----------------------------------------------------------------
 
     def _handle_aux(self, sender_id: Any, b: bool) -> Step:
-        if not self.received_aux.insert(b, sender_id):
-            return Step()
+        if sender_id in self.received_aux:
+            return Step()  # only the first Aux per sender counts
+        self.received_aux[sender_id] = b
         return self._try_output()
 
     def _try_output(self) -> Step:
         if self.output is not None or not self.bin_values:
             return Step()
-        # Count Aux senders whose value is in bin_values.
+        # Count distinct Aux senders whose value is in bin_values.
         vals = BoolSet.none()
         count = 0
-        for b in self.bin_values:
-            senders = self.received_aux[b]
-            if senders:
+        for sender, b in self.received_aux.items():
+            if self.bin_values.contains(b):
                 vals = vals.inserted(b)
-                count += len(senders)
+                count += 1
         if count < self.netinfo.num_correct():
             return Step()
         self.output = vals

@@ -1,0 +1,154 @@
+"""BLS12-381 golden-reference tests: curve laws, pairing bilinearity, and
+the full threshold-crypto stack running over the real curve.
+
+These anchor correctness for the JAX/TPU limb kernels (hbbft_tpu/ops/),
+which are golden-tested against this module.  Marked partially slow: a
+Python pairing costs ~0.4s.
+"""
+
+import random
+
+import pytest
+
+from hbbft_tpu.crypto import bls381 as B
+from hbbft_tpu.crypto.backend import CpuBackend
+from hbbft_tpu.crypto.field import Q, R
+from hbbft_tpu.crypto.keys import SecretKey, SecretKeySet
+
+
+def test_generators_and_orders():
+    assert B.g1_on_curve(B.G1_GEN)
+    assert B.g2_on_curve(B.G2_GEN)
+    assert B.ec_mul(B.FQ, R, B.G1_GEN) is None
+    assert B.ec_mul(B.FQ2, R, B.G2_GEN) is None
+
+
+def test_ec_group_laws():
+    rng = random.Random(0)
+    a, b = rng.randrange(R), rng.randrange(R)
+    P = B.ec_mul(B.FQ, a, B.G1_GEN)
+    Qp = B.ec_mul(B.FQ, b, B.G1_GEN)
+    # commutativity + distributivity of scalar mult
+    assert B.ec_add(B.FQ, P, Qp) == B.ec_add(B.FQ, Qp, P)
+    assert B.ec_add(B.FQ, P, Qp) == B.ec_mul(B.FQ, (a + b) % R, B.G1_GEN)
+    # inverse
+    assert B.ec_add(B.FQ, P, B.ec_neg(B.FQ, P)) is None
+    # same over Fq2
+    P2 = B.ec_mul(B.FQ2, a, B.G2_GEN)
+    Q2 = B.ec_mul(B.FQ2, b, B.G2_GEN)
+    assert B.ec_add(B.FQ2, P2, Q2) == B.ec_mul(B.FQ2, (a + b) % R, B.G2_GEN)
+
+
+def test_fq2_fq6_fq12_field_laws():
+    rng = random.Random(1)
+
+    def r2():
+        return (rng.randrange(Q), rng.randrange(Q))
+
+    a, b, c = r2(), r2(), r2()
+    assert B.fq2_mul(a, b) == B.fq2_mul(b, a)
+    assert B.fq2_mul(a, B.fq2_add(b, c)) == B.fq2_add(B.fq2_mul(a, b), B.fq2_mul(a, c))
+    assert B.fq2_mul(a, B.fq2_inv(a)) == B.FQ2_ONE
+
+    a6 = (r2(), r2(), r2())
+    b6 = (r2(), r2(), r2())
+    assert B.fq6_mul(a6, b6) == B.fq6_mul(b6, a6)
+    assert B.fq6_mul(a6, B.fq6_inv(a6)) == B.FQ6_ONE
+    # v³ = ξ: multiplying by v three times == multiplying by ξ
+    v3 = B.fq6_mul_by_v(B.fq6_mul_by_v(B.fq6_mul_by_v(a6)))
+    xi_a = tuple(B.fq2_mul_xi(x) for x in a6)
+    assert v3 == xi_a
+
+    a12 = (a6, b6)
+    assert B.fq12_mul(a12, B.fq12_inv(a12)) == B.FQ12_ONE
+    # w² = v
+    assert B.fq12_mul(B.FQ12_W, B.FQ12_W) == B.FQ12_W2
+
+
+def test_fq2_sqrt():
+    rng = random.Random(2)
+    for _ in range(10):
+        a = (rng.randrange(Q), rng.randrange(Q))
+        sq = B.fq2_sqr(a)
+        s = B.fq2_sqrt(sq)
+        assert s is not None and B.fq2_sqr(s) == sq
+
+
+@pytest.mark.slow
+def test_pairing_bilinearity():
+    e = B.pairing(B.G1_GEN, B.G2_GEN)
+    assert e != B.FQ12_ONE
+    # e(aP, bQ) == e(P,Q)^(ab) == e(bP, aQ)
+    a, b = 5, 11
+    lhs = B.pairing(B.ec_mul(B.FQ, a, B.G1_GEN), B.ec_mul(B.FQ2, b, B.G2_GEN))
+    assert lhs == B.fq12_pow(e, a * b)
+    rhs = B.pairing(B.ec_mul(B.FQ, b, B.G1_GEN), B.ec_mul(B.FQ2, a, B.G2_GEN))
+    assert lhs == rhs
+    # additivity in the first argument
+    p3 = B.ec_add(B.FQ, B.G1_GEN, B.ec_mul(B.FQ, 2, B.G1_GEN))
+    assert B.pairing(p3, B.G2_GEN) == B.fq12_pow(e, 3)
+
+
+def test_serialization_roundtrip():
+    rng = random.Random(3)
+    for _ in range(3):
+        k = rng.randrange(R)
+        p1 = B.ec_mul(B.FQ, k, B.G1_GEN)
+        p2 = B.ec_mul(B.FQ2, k, B.G2_GEN)
+        assert B.g1_from_bytes(B.g1_to_bytes(p1)) == p1
+        assert B.g2_from_bytes(B.g2_to_bytes(p2)) == p2
+    assert B.g1_from_bytes(B.g1_to_bytes(None)) is None
+    assert B.g2_from_bytes(B.g2_to_bytes(None)) is None
+    with pytest.raises(ValueError):
+        B.g1_from_bytes(b"\x00" * 48)
+
+
+def test_hash_to_curve_subgroup_and_determinism():
+    h1 = B.hash_to_g1(b"doc")
+    h2 = B.hash_to_g2(b"doc")
+    assert B.hash_to_g1(b"doc") == h1  # deterministic
+    assert B.hash_to_g2(b"doc") == h2
+    assert B.hash_to_g1(b"other") != h1
+    assert B.ec_mul(B.FQ, R, h1) is None  # in the r-subgroup
+    assert B.ec_mul(B.FQ2, R, h2) is None
+
+
+@pytest.mark.slow
+def test_threshold_stack_on_real_curve():
+    """The full generic threshold layer over real BLS12-381: sign share,
+    verify share (pairing), combine, verify combined; encrypt, decrypt
+    share, verify share, combine."""
+    backend = CpuBackend()
+    g = backend.group
+    rng = random.Random(4)
+    sk_set = SecretKeySet.random(g, threshold=1, rng=rng)
+    pk_set = sk_set.public_keys()
+    doc = b"the doc"
+    shares = {i: sk_set.secret_key_share(i).sign_share(doc) for i in range(3)}
+    assert pk_set.public_key_share(0).verify_sig_share(shares[0], doc)
+    bad = sk_set.secret_key_share(0).sign_share(b"bad")
+    assert not pk_set.public_key_share(0).verify_sig_share(bad, doc)
+    sig_a = pk_set.combine_signatures({i: shares[i] for i in (0, 1)})
+    sig_b = pk_set.combine_signatures({i: shares[i] for i in (1, 2)})
+    assert sig_a == sig_b
+    assert pk_set.public_key().verify(sig_a, doc)
+
+    msg = b"sixteen byte msg"
+    ct = pk_set.encrypt(msg, rng)
+    assert ct.verify()
+    dshares = {}
+    for i in (0, 2):
+        d = sk_set.secret_key_share(i).decrypt_share(ct)
+        assert pk_set.public_key_share(i).verify_decryption_share(d, ct)
+        dshares[i] = d
+    assert pk_set.combine_decryption_shares(dshares, ct) == msg
+
+
+@pytest.mark.slow
+def test_plain_bls_signature_on_real_curve():
+    g = CpuBackend().group
+    rng = random.Random(5)
+    sk = SecretKey.random(g, rng)
+    sig = sk.sign(b"m")
+    assert sk.public_key().verify(sig, b"m")
+    assert not sk.public_key().verify(sig, b"n")

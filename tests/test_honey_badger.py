@@ -1,0 +1,182 @@
+"""HoneyBadger integration tests (reference `tests/honey_badger.rs` § shape):
+all correct nodes output identical batch sequences; every correct node's
+contribution eventually commits; encryption schedules and adversaries don't
+break agreement."""
+
+import pytest
+
+from hbbft_tpu.net.adversary import ReorderingAdversary, SilentAdversary
+from hbbft_tpu.net.virtual_net import NetBuilder
+from hbbft_tpu.protocols.honey_badger import (
+    Batch,
+    EncryptionSchedule,
+    HoneyBadger,
+)
+
+
+def build(n, f=0, adversary=None, defer_mode="eager", seed=0, schedule=None):
+    schedule = schedule or EncryptionSchedule.always()
+    b = (
+        NetBuilder(range(n))
+        .num_faulty(f)
+        .defer_mode(defer_mode)
+        .crank_limit(5_000_000)
+        .using(
+            lambda ni, be: HoneyBadger(
+                ni, be, session_id=b"test-hb", encryption_schedule=schedule
+            )
+        )
+    )
+    if adversary:
+        b = b.adversary(adversary)
+    return b.build(seed=seed)
+
+
+def run_epochs(net, n_epochs, defer_mode="eager"):
+    """Each epoch every node proposes a contribution; crank until all
+    correct nodes emitted the epoch's batch."""
+    for e in range(n_epochs):
+        for i in sorted(net.nodes):
+            net.send_input(i, {"from": i, "epoch": e})
+        net.crank_until(
+            lambda net: all(
+                len(node.outputs) >= e + 1 for node in net.correct_nodes()
+            )
+        )
+
+
+def assert_identical_batches(net, n_epochs):
+    ref = None
+    for node in net.correct_nodes():
+        batches = node.outputs[:n_epochs]
+        assert len(batches) == n_epochs
+        for i, b in enumerate(batches):
+            assert isinstance(b, Batch) and b.epoch == i
+        if ref is None:
+            ref = batches
+        assert batches == ref, f"node {node.id} diverged"
+
+
+@pytest.mark.parametrize("n,f", [(1, 0), (4, 1)])
+@pytest.mark.parametrize("defer_mode", ["eager"])
+def test_batches_identical(n, f, defer_mode):
+    net = build(n, f, defer_mode=defer_mode)
+    run_epochs(net, 3)
+    assert_identical_batches(net, 3)
+    # Every epoch commits ≥ N - f contributions, each intact.
+    for b in net.correct_nodes()[0].outputs[:3]:
+        assert len(b.contributions) >= n - f
+        for p, c in b.contributions.items():
+            assert c == {"from": p, "epoch": b.epoch}
+
+
+def test_round_mode_agrees_with_eager():
+    batches = {}
+    for mode in ("eager", "round"):
+        net = build(4, 1, defer_mode=mode, seed=42)
+        for i in sorted(net.nodes):
+            net.send_input(i, (i, "x"))
+        if mode == "round":
+            while net.queue or net._pending_work:
+                net.crank_round()
+        else:
+            net.crank_to_quiescence()
+        batches[mode] = [n.outputs[0] for n in net.correct_nodes()]
+    # Same seed ⇒ identical first batch in both crypto modes.
+    assert batches["eager"] == batches["round"]
+
+
+@pytest.mark.parametrize(
+    "schedule",
+    [
+        EncryptionSchedule.never(),
+        EncryptionSchedule.every_nth(2),
+        EncryptionSchedule.tick_tock(1, 1),
+    ],
+)
+def test_encryption_schedules(schedule):
+    net = build(4, 1, schedule=schedule, seed=3)
+    run_epochs(net, 3)
+    assert_identical_batches(net, 3)
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_adversarial_reordering(seed):
+    net = build(4, 1, adversary=ReorderingAdversary(), seed=seed)
+    run_epochs(net, 2)
+    assert_identical_batches(net, 2)
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_silent_faulty(seed):
+    net = build(7, 2, adversary=SilentAdversary(), seed=seed)
+    for i in sorted(net.nodes):
+        net.send_input(i, ("tx", i))
+    net.crank_until(
+        lambda net: all(len(n.outputs) >= 1 for n in net.correct_nodes())
+    )
+    ref = None
+    for node in net.correct_nodes():
+        b = node.outputs[0]
+        assert len(b.contributions) >= 5
+        if ref is None:
+            ref = b
+        assert b == ref
+
+
+def test_garbage_ciphertext_skipped_not_fatal():
+    """A faulty proposer whose subset payload isn't a valid ciphertext gets
+    skipped with a fault, and the epoch still completes."""
+    from hbbft_tpu.net.adversary import Adversary
+
+    class GarbageProposal(Adversary):
+        def tamper(self, net, msg):
+            # Corrupt only broadcast Value messages originating at the faulty
+            # node's own proposal (its shard dissemination).
+            from hbbft_tpu.protocols.honey_badger import HbMessage
+            from hbbft_tpu.protocols.subset import SubsetMessage
+            from hbbft_tpu.protocols.broadcast import BroadcastMessage
+
+            m = msg.payload
+            if (
+                isinstance(m, HbMessage)
+                and m.kind == "subset"
+                and isinstance(m.payload, SubsetMessage)
+                and m.payload.proposer == msg.sender
+                and isinstance(m.payload.payload, BroadcastMessage)
+                and m.payload.payload.kind == "value"
+            ):
+                proof = m.payload.payload.payload
+                # Flip bytes in the shard: Merkle proof stays self-consistent?
+                # No - produce a *valid-looking* but wrong value by reusing the
+                # proof of garbage content via a fresh broadcast. Simplest:
+                # leave proof alone but truncate... just corrupt the value.
+                from hbbft_tpu.crypto.merkle import MerkleTree
+
+                n = net.nodes[msg.sender].algorithm.netinfo.num_nodes()
+                shards = [b"garbage!" for _ in range(n)]
+                tree = MerkleTree(shards)
+                idx = proof.index
+                new_msg = HbMessage.subset(
+                    m.epoch,
+                    SubsetMessage(
+                        m.payload.proposer,
+                        "broadcast",
+                        BroadcastMessage.value(tree.proof(idx)),
+                    ),
+                )
+                return [type(msg)(msg.sender, msg.to, new_msg)]
+            return [msg]
+
+    net = build(4, 1, adversary=GarbageProposal(), seed=2)
+    for i in sorted(net.nodes):
+        net.send_input(i, ("c", i))
+    net.crank_until(
+        lambda net: all(len(n.outputs) >= 1 for n in net.correct_nodes())
+    )
+    ref = None
+    for node in net.correct_nodes():
+        b = node.outputs[0]
+        if ref is None:
+            ref = b
+        assert b == ref

@@ -1,0 +1,89 @@
+"""Subset (ACS) integration tests (reference `tests/subset.rs` § shape):
+all correct nodes output the same set of ≥ N−f contributions, including
+every contribution proposed by all correct nodes... under adversarial
+scheduling and silent faults."""
+
+import pytest
+
+from hbbft_tpu.net.adversary import ReorderingAdversary, SilentAdversary
+from hbbft_tpu.net.virtual_net import NetBuilder
+from hbbft_tpu.protocols.subset import Subset, SubsetOutput
+
+
+def build(n, f=0, adversary=None, defer_mode="eager", seed=0):
+    b = (
+        NetBuilder(range(n))
+        .num_faulty(f)
+        .defer_mode(defer_mode)
+        .crank_limit(2_000_000)
+        .using(lambda ni, be: Subset(ni, be, session_id=b"test-subset"))
+    )
+    if adversary:
+        b = b.adversary(adversary)
+    return b.build(seed=seed)
+
+
+def run_to_done(net, defer_mode="eager"):
+    if defer_mode == "round":
+        while net.queue or net._pending_work:
+            net.crank_round()
+    else:
+        net.crank_to_quiescence()
+
+
+def contributions(node):
+    return {
+        o.proposer: o.value for o in node.outputs if o.kind == "contribution"
+    }
+
+
+@pytest.mark.parametrize("n,f", [(1, 0), (2, 0), (4, 1), (7, 2)])
+@pytest.mark.parametrize("defer_mode", ["eager", "round"])
+def test_all_agree_on_subset(n, f, defer_mode):
+    net = build(n, f, defer_mode=defer_mode)
+    for i in sorted(net.nodes):
+        net.send_input(i, b"contribution-%d" % i)
+    run_to_done(net, defer_mode)
+    ref = None
+    for node in net.correct_nodes():
+        assert node.outputs and node.outputs[-1].kind == "done", (
+            f"node {node.id} incomplete: {node.outputs}"
+        )
+        cs = contributions(node)
+        assert len(cs) >= n - f
+        for p, v in cs.items():
+            assert v == b"contribution-%d" % p
+        if ref is None:
+            ref = cs
+        assert cs == ref, f"node {node.id} diverged"
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_adversarial_reordering(seed):
+    net = build(4, 1, adversary=ReorderingAdversary(), seed=seed)
+    for i in sorted(net.nodes):
+        net.send_input(i, b"c%d" % i)
+    run_to_done(net)
+    ref = None
+    for node in net.correct_nodes():
+        assert node.outputs[-1].kind == "done"
+        cs = contributions(node)
+        if ref is None:
+            ref = cs
+        assert cs == ref
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_silent_faulty_nodes(seed):
+    net = build(7, 2, adversary=SilentAdversary(), seed=seed)
+    for i in sorted(net.nodes):
+        net.send_input(i, b"c%d" % i)
+    run_to_done(net)
+    ref = None
+    for node in net.correct_nodes():
+        assert node.outputs[-1].kind == "done"
+        cs = contributions(node)
+        assert len(cs) >= 7 - 2
+        if ref is None:
+            ref = cs
+        assert cs == ref

@@ -1,0 +1,131 @@
+"""ThresholdDecrypt integration tests (reference shape: SURVEY.md §4)."""
+
+import random
+
+import pytest
+
+from hbbft_tpu.crypto.backend import MockBackend
+from hbbft_tpu.net.adversary import ReorderingAdversary, SilentAdversary
+from hbbft_tpu.net.virtual_net import NetBuilder
+from hbbft_tpu.protocols.threshold_decrypt import ThresholdDecrypt
+
+MSG = b"the secret plaintext payload"
+
+
+def build_with_ct(n, f=0, adversary=None, defer_mode="eager", seed=0):
+    """Build a net of ThresholdDecrypt instances sharing one ciphertext."""
+    b = (
+        NetBuilder(range(n))
+        .num_faulty(f)
+        .defer_mode(defer_mode)
+        .using(lambda ni, be: ThresholdDecrypt(ni, be))
+    )
+    if adversary:
+        b = b.adversary(adversary)
+    net = b.build(seed=seed)
+    pk_set = net.nodes[0].algorithm.netinfo.public_key_set
+    ct = pk_set.encrypt(MSG, random.Random(seed + 1000))
+    for nid in sorted(net.nodes):
+        node = net.nodes[nid]
+        step = node.algorithm.set_ciphertext(ct)
+        net._process_step(node, step)
+    return net, ct
+
+
+@pytest.mark.parametrize("n,f", [(1, 0), (4, 1), (7, 2)])
+@pytest.mark.parametrize("defer_mode", ["eager", "round"])
+def test_all_decrypt_same(n, f, defer_mode):
+    net, _ = build_with_ct(n, f, defer_mode=defer_mode)
+    net.broadcast_input(None)
+    if defer_mode == "round":
+        while net.queue or net._pending_work:
+            net.crank_round()
+    else:
+        net.crank_to_quiescence()
+    for node in net.correct_nodes():
+        assert node.outputs == [MSG]
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_silent_faulty(seed):
+    net, _ = build_with_ct(7, 2, adversary=SilentAdversary(), seed=seed)
+    net.broadcast_input(None)
+    net.crank_to_quiescence()
+    for node in net.correct_nodes():
+        assert node.outputs == [MSG]
+
+
+def test_shares_before_ciphertext_are_buffered():
+    """A node that learns the ciphertext late still decrypts."""
+    b = (
+        NetBuilder(range(4))
+        .num_faulty(1)
+        .using(lambda ni, be: ThresholdDecrypt(ni, be))
+    )
+    net = b.build(seed=3)
+    pk_set = net.nodes[0].algorithm.netinfo.public_key_set
+    ct = pk_set.encrypt(MSG, random.Random(7))
+    # Only nodes 0-2 get the ciphertext now.
+    for nid in [0, 1, 2]:
+        node = net.nodes[nid]
+        net._process_step(node, node.algorithm.set_ciphertext(ct))
+        net._process_step(node, node.algorithm.start_decryption())
+    # Deliver everything: node 3's shares buffer (no ct yet).
+    net.crank_to_quiescence()
+    assert net.nodes[3].outputs == []
+    # Late ciphertext: buffered shares drain and it catches up.
+    node3 = net.nodes[3]
+    net._process_step(node3, node3.algorithm.set_ciphertext(ct))
+    net._process_step(node3, node3.algorithm.start_decryption())
+    net.crank_to_quiescence()
+    assert node3.outputs == [MSG]
+
+
+def test_invalid_ciphertext_rejected():
+    from hbbft_tpu.crypto.keys import Ciphertext
+
+    backend = MockBackend()
+    net = (
+        NetBuilder(range(4))
+        .num_faulty(1)
+        .backend(backend)
+        .using(lambda ni, be: ThresholdDecrypt(ni, be))
+        .build(seed=5)
+    )
+    pk_set = net.nodes[0].algorithm.netinfo.public_key_set
+    good = pk_set.encrypt(MSG, random.Random(1))
+    # Tamper W so the validity pairing fails.
+    bad = Ciphertext(backend.group, good.u, good.v, backend.group.g2_mul(3, good.w))
+    node = net.nodes[0]
+    net._process_step(node, node.algorithm.set_ciphertext(bad))
+    assert node.algorithm.terminated()
+    assert node.outputs == []
+
+
+def test_corrupted_share_flagged_and_tolerated():
+    from hbbft_tpu.crypto.keys import DecryptionShare
+    from hbbft_tpu.net.adversary import RandomAdversary
+    from hbbft_tpu.protocols.threshold_decrypt import ThresholdDecryptMessage
+
+    def garbage(net, msg):
+        el = net.backend.group.hash_to_g1(bytes([net.rng.randrange(256)]))
+        return ThresholdDecryptMessage(DecryptionShare(net.backend.group, el))
+
+    b = (
+        NetBuilder(range(4))
+        .num_faulty(1)
+        .adversary(RandomAdversary(garbage, p_replace=1.0))
+        .using(lambda ni, be: ThresholdDecrypt(ni, be))
+    )
+    net = b.build(seed=11)
+    pk_set = net.nodes[0].algorithm.netinfo.public_key_set
+    ct = pk_set.encrypt(MSG, random.Random(2))
+    for nid in sorted(net.nodes):
+        node = net.nodes[nid]
+        net._process_step(node, node.algorithm.set_ciphertext(ct))
+    net.broadcast_input(None)
+    net.crank_to_quiescence()
+    for node in net.correct_nodes():
+        assert node.outputs == [MSG]
+    faults = [f for n in net.correct_nodes() for f in n.faults_observed]
+    assert any(f.kind == "threshold_decrypt:invalid_share" for f in faults)
