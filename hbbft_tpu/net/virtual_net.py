@@ -292,7 +292,10 @@ class NetBuilder:
     def using(
         self, constructor: Callable[[NetworkInfo, CryptoBackend], Any]
     ) -> "NetBuilder":
-        """``constructor(netinfo, backend) -> protocol instance`` per node."""
+        """``constructor(netinfo, backend[, rng]) -> protocol instance`` per
+        node.  Constructors that accept a third argument receive the net's
+        seeded rng (needed by protocols that generate key material, e.g.
+        DynamicHoneyBadger's in-band DKG)."""
         self._constructor = constructor
         return self
 
@@ -303,10 +306,23 @@ class NetBuilder:
         backend = self._backend or MockBackend()
         netinfos = NetworkInfo.generate_map(self._ids, rng, backend)
         faulty_ids = set(rng.sample(self._ids, self._num_faulty))
+
+        import inspect
+
+        try:
+            n_params = len(inspect.signature(self._constructor).parameters)
+        except (TypeError, ValueError):
+            n_params = 2
+
+        def construct(nid):
+            if n_params >= 3:
+                return self._constructor(netinfos[nid], backend, rng)
+            return self._constructor(netinfos[nid], backend)
+
         nodes = {
             nid: Node(
                 id=nid,
-                algorithm=self._constructor(netinfos[nid], backend),
+                algorithm=construct(nid),
                 faulty=nid in faulty_ids,
             )
             for nid in self._ids

@@ -119,6 +119,8 @@ class BinaryAgreement(ConsensusProtocol):
     def handle_message(self, sender_id: Any, message: BaMessage, rng=None) -> Step:
         if not isinstance(message, BaMessage):
             return Step.from_fault(sender_id, "binary_agreement:malformed_message")
+        if self.netinfo.node_index(sender_id) is None:
+            return Step.from_fault(sender_id, "binary_agreement:non_validator_sender")
         if message.kind == "term":
             return self._handle_term(sender_id, message)
         if self.decision is not None:

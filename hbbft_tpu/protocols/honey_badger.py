@@ -226,7 +226,7 @@ class HoneyBadger(ConsensusProtocol):
         )
 
     def handle_message(self, sender_id: Any, message: HbMessage, rng=None) -> Step:
-        if not isinstance(message, HbMessage):
+        if not isinstance(message, HbMessage) or not isinstance(message.epoch, int):
             return Step.from_fault(sender_id, "honey_badger:malformed_message")
         e = message.epoch
         if e < self.epoch:

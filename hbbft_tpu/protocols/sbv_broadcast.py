@@ -56,6 +56,9 @@ class SbvBroadcast:
         return self.send_bval(b)
 
     def handle_message(self, sender_id: Any, msg: SbvMessage) -> Step:
+        if self.netinfo.node_index(sender_id) is None:
+            # Non-validators (observers) must not count toward quorums.
+            return Step.from_fault(sender_id, "sbv:non_validator_sender")
         if msg.kind == "bval":
             return self._handle_bval(sender_id, msg.value)
         if msg.kind == "aux":

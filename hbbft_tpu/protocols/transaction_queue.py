@@ -1,0 +1,55 @@
+"""Transaction queue with random-sample proposals.
+
+Rebuild of `src/transaction_queue.rs` § (SURVEY.md §2.1): a buffer of
+pending transactions from which each epoch's proposal is a *random sample* —
+randomization decorrelates the N nodes' proposals so the union (the ACS
+output) covers more distinct transactions per epoch.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable, List
+
+
+class TransactionQueue:
+    """Default FIFO-set queue (insertion-ordered, deduplicated)."""
+
+    def __init__(self, txs: Iterable[Any] = ()) -> None:
+        self._txs: dict = {}  # insertion-ordered set
+        for tx in txs:
+            self.push(tx)
+
+    def push(self, tx: Any) -> None:
+        self._txs.setdefault(_key(tx), tx)
+
+    def extend(self, txs: Iterable[Any]) -> None:
+        for tx in txs:
+            self.push(tx)
+
+    def __len__(self) -> int:
+        return len(self._txs)
+
+    def __contains__(self, tx: Any) -> bool:
+        return _key(tx) in self._txs
+
+    def choose(self, rng, amount: int) -> List[Any]:
+        """Random sample of up to ``amount`` transactions."""
+        items = list(self._txs.values())
+        if len(items) <= amount:
+            return items
+        return rng.sample(items, amount)
+
+    def remove_multiple(self, txs: Iterable[Any]) -> None:
+        for tx in txs:
+            self._txs.pop(_key(tx), None)
+
+
+def _key(tx: Any):
+    """Hashable identity for a transaction (lists/dicts via canonical bytes)."""
+    try:
+        hash(tx)
+        return tx
+    except TypeError:
+        from hbbft_tpu.utils import canonical
+
+        return canonical.encode(tx)

@@ -1,0 +1,202 @@
+"""DynamicHoneyBadger integration tests (reference
+`tests/dynamic_honey_badger.rs` § shape): vote out a validator, vote one in
+from a JoinPlan, switch the encryption schedule — consensus keeps running
+across era changes and all correct nodes agree on every batch."""
+
+import random
+
+import pytest
+
+from hbbft_tpu.crypto.backend import MockBackend
+from hbbft_tpu.crypto.keys import SecretKey
+from hbbft_tpu.net.adversary import ReorderingAdversary
+from hbbft_tpu.net.virtual_net import NetBuilder, Node
+from hbbft_tpu.protocols.change import Change, ChangeState
+from hbbft_tpu.protocols.dynamic_honey_badger import (
+    DhbBatch,
+    DynamicHoneyBadger,
+    JoinPlan,
+)
+from hbbft_tpu.protocols.honey_badger import EncryptionSchedule
+
+
+def build(n, f=0, adversary=None, seed=0):
+    b = (
+        NetBuilder(range(n))
+        .num_faulty(f)
+        .crank_limit(5_000_000)
+        .using(
+            lambda ni, be, rng: DynamicHoneyBadger(
+                ni, be, rng=rng, session_id=b"test-dhb"
+            )
+        )
+    )
+    if adversary:
+        b = b.adversary(adversary)
+    return b.build(seed=seed)
+
+
+def drive_epoch(net, epoch_idx, contribute=lambda i, e: ("tx", i, e)):
+    """All current validators propose; crank until everyone has the batch."""
+    for i in sorted(net.nodes):
+        algo = net.nodes[i].algorithm
+        if algo.netinfo.is_validator():
+            net._process_step(
+                net.nodes[i], algo.propose(contribute(i, epoch_idx))
+            )
+    net.crank_until(
+        lambda n: all(
+            len(node.outputs) >= epoch_idx + 1 for node in n.correct_nodes()
+        )
+    )
+
+
+def assert_batches_agree(net):
+    nodes = net.correct_nodes()
+    n_common = min(len(n.outputs) for n in nodes)
+    ref = nodes[0].outputs[:n_common]
+    for n in nodes[1:]:
+        assert n.outputs[:n_common] == ref, f"node {n.id} diverged"
+    return ref
+
+
+def test_steady_state_epochs():
+    net = build(4)
+    for e in range(3):
+        drive_epoch(net, e)
+    batches = assert_batches_agree(net)
+    assert [b.era for b in batches] == [0, 0, 0]
+    assert all(b.change == ChangeState.none() for b in batches)
+    for e, b in enumerate(batches):
+        assert len(b.contributions) >= 3
+        for p, c in b.contributions.items():
+            assert c == ("tx", p, e)
+
+
+def test_vote_to_remove_validator():
+    net = build(4, seed=1)
+    # Everyone votes to remove node 3.
+    for i in sorted(net.nodes):
+        net._process_step(net.nodes[i], net.nodes[i].algorithm.vote_to_remove(3))
+    epoch = 0
+    # Drive epochs until the change completes (vote commit -> DKG -> era).
+    for _ in range(12):
+        drive_epoch(net, epoch)
+        epoch += 1
+        last = net.nodes[0].outputs[-1]
+        if last.change == ChangeState.complete(Change.remove(3)):
+            break
+    else:
+        raise AssertionError(
+            f"change never completed: {[b.change for b in net.nodes[0].outputs]}"
+        )
+    assert_batches_agree(net)
+    # After era change: 3 validators, node 3 is an observer.
+    for i in (0, 1, 2):
+        ni = net.nodes[i].algorithm.netinfo
+        assert ni.num_nodes() == 3 and ni.is_validator()
+        assert net.nodes[i].algorithm.era == 1
+    assert not net.nodes[3].algorithm.netinfo.is_validator()
+    # Consensus still works in the new era (node 3 left out).
+    n_before = len(net.nodes[0].outputs)
+    for i in (0, 1, 2):
+        algo = net.nodes[i].algorithm
+        net._process_step(net.nodes[i], algo.propose(("postchange", i)))
+    net.crank_until(
+        lambda n: all(
+            len(n.nodes[i].outputs) > n_before for i in (0, 1, 2)
+        )
+    )
+    new_batch = net.nodes[0].outputs[n_before]
+    assert new_batch.era == 1
+    assert len(new_batch.contributions) >= 2
+
+
+def test_vote_to_add_validator_with_join_plan():
+    net = build(4, seed=2)
+    backend = net.backend
+    rng = random.Random(777)
+    joiner_sk = SecretKey.random(backend.group, rng)
+    joiner_pk = joiner_sk.public_key()
+
+    # The joiner starts as an observer from a JoinPlan of era 0.
+    plan = net.nodes[0].algorithm.join_plan()
+    joiner = DynamicHoneyBadger.new_joining(
+        our_id=4,
+        secret_key=joiner_sk,
+        join_plan=plan,
+        backend=backend,
+        rng=rng,
+        session_id=b"test-dhb",
+    )
+    net.nodes[4] = Node(id=4, algorithm=joiner, faulty=False)
+    net._sorted_ids = sorted(net.nodes)
+    net._node_order = {n: i for i, n in enumerate(net._sorted_ids)}
+    assert not joiner.netinfo.is_validator()
+
+    # Validators vote the joiner in.
+    for i in range(4):
+        net._process_step(
+            net.nodes[i], net.nodes[i].algorithm.vote_to_add(4, joiner_pk)
+        )
+    epoch = 0
+    for _ in range(12):
+        drive_epoch(net, epoch)
+        epoch += 1
+        last = net.nodes[0].outputs[-1]
+        if last.change.kind == "complete":
+            break
+    else:
+        raise AssertionError("add-change never completed")
+    assert_batches_agree(net)
+    # New era: 5 validators including the joiner, who now holds a key share.
+    for i in range(5):
+        algo = net.nodes[i].algorithm
+        assert algo.era == 1, f"node {i} era {algo.era}"
+        assert algo.netinfo.num_nodes() == 5
+        assert algo.netinfo.is_validator(), f"node {i} not validator"
+    # The new era commits batches with the joiner contributing.
+    n_before = min(len(net.nodes[i].outputs) for i in range(5))
+    for i in range(5):
+        algo = net.nodes[i].algorithm
+        net._process_step(net.nodes[i], algo.propose(("era1", i)))
+    net.crank_until(
+        lambda n: all(len(n.nodes[i].outputs) > n_before for i in range(5))
+    )
+    batch = net.nodes[4].outputs[-1]
+    assert batch.era == 1 and len(batch.contributions) >= 4
+
+
+def test_encryption_schedule_change():
+    net = build(4, seed=3)
+    sched = EncryptionSchedule.every_nth(2)
+    for i in sorted(net.nodes):
+        net._process_step(
+            net.nodes[i],
+            net.nodes[i].algorithm.vote_for(Change.set_schedule(sched)),
+        )
+    drive_epoch(net, 0)
+    batches = assert_batches_agree(net)
+    assert batches[0].change == ChangeState.complete(Change.set_schedule(sched))
+    for i in sorted(net.nodes):
+        algo = net.nodes[i].algorithm
+        assert algo.era == 1
+        assert algo.encryption_schedule == sched
+        # Keys carried over: still 4 validators.
+        assert algo.netinfo.num_nodes() == 4 and algo.netinfo.is_validator()
+
+
+@pytest.mark.parametrize("seed", range(2))
+def test_remove_under_reordering_adversary(seed):
+    net = build(4, f=1, adversary=ReorderingAdversary(), seed=seed)
+    for i in sorted(net.nodes):
+        net._process_step(net.nodes[i], net.nodes[i].algorithm.vote_to_remove(3))
+    epoch = 0
+    for _ in range(15):
+        drive_epoch(net, epoch)
+        epoch += 1
+        if net.correct_nodes()[0].outputs[-1].change.kind == "complete":
+            break
+    else:
+        raise AssertionError("change never completed under adversary")
+    assert_batches_agree(net)

@@ -1,0 +1,131 @@
+"""QueueingHoneyBadger integration tests (reference
+`tests/queueing_honey_badger.rs` § shape): transactions pushed to any node
+eventually commit exactly once, in the same batch order on all correct
+nodes; validator churn (remove + re-add) doesn't stop the pipeline."""
+
+import pytest
+
+from hbbft_tpu.net.adversary import ReorderingAdversary
+from hbbft_tpu.net.virtual_net import NetBuilder
+from hbbft_tpu.protocols.change import ChangeState
+from hbbft_tpu.protocols.queueing_honey_badger import QueueingHoneyBadger
+
+
+def build(n, f=0, batch_size=3, adversary=None, seed=0):
+    b = (
+        NetBuilder(range(n))
+        .num_faulty(f)
+        .crank_limit(10_000_000)
+        .using(
+            lambda ni, be, rng: QueueingHoneyBadger(
+                ni, be, rng=rng, batch_size=batch_size, session_id=b"test-qhb"
+            )
+        )
+    )
+    if adversary:
+        b = b.adversary(adversary)
+    return b.build(seed=seed)
+
+
+def committed_txs(node):
+    out = []
+    for batch in node.outputs:
+        for p, txs in sorted(batch.contributions.items(), key=lambda kv: repr(kv[0])):
+            if isinstance(txs, list):
+                out.extend(tx for tx in txs if tx not in out)
+    return out
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_all_transactions_commit(seed):
+    net = build(4, f=1, seed=seed)
+    txs = [("tx", i) for i in range(12)]
+    # Feed each transaction to every node (clients broadcast to all).
+    for tx in txs:
+        for i in sorted(net.nodes):
+            net._process_step(net.nodes[i], net.nodes[i].algorithm.push_transaction(tx))
+    net.crank_until(
+        lambda n: all(
+            set(txs) <= set(committed_txs(node)) for node in n.correct_nodes()
+        ),
+        max_cranks=2_000_000,
+    )
+    # Same committed order everywhere.
+    orders = [committed_txs(node) for node in net.correct_nodes()]
+    assert all(o == orders[0] for o in orders)
+    # No duplicates within any node's batches.
+    for node in net.correct_nodes():
+        seen = []
+        for b in node.outputs:
+            for p, txs_c in b.contributions.items():
+                seen.extend(txs_c)
+        # (duplicate proposals may occur across proposers in one epoch; the
+        # committed ORDER list dedups - here we just sanity-check volume)
+        assert len(committed_txs(node)) >= len(txs)
+
+
+def test_transactions_removed_from_queue():
+    net = build(4, seed=5)
+    for t in range(6):
+        for i in sorted(net.nodes):
+            net._process_step(
+                net.nodes[i], net.nodes[i].algorithm.push_transaction(("t", t))
+            )
+    net.crank_until(
+        lambda n: all(
+            len(node.algorithm.queue) == 0 for node in n.correct_nodes()
+        ),
+        max_cranks=2_000_000,
+    )
+
+
+def test_churn_remove_then_readd():
+    """Vote a node out, then vote it back in, while transactions flow."""
+    net = build(4, seed=7)
+    pk3 = net.nodes[3].algorithm.netinfo.public_key(3)
+    for t in range(4):
+        for i in sorted(net.nodes):
+            net._process_step(
+                net.nodes[i], net.nodes[i].algorithm.push_transaction(("pre", t))
+            )
+    for i in sorted(net.nodes):
+        net._process_step(net.nodes[i], net.nodes[i].algorithm.vote_to_remove(3))
+    net.crank_until(
+        lambda n: all(
+            node.algorithm.dhb.era >= 1 for node in n.correct_nodes()
+        ),
+        max_cranks=2_000_000,
+    )
+    assert not net.nodes[3].algorithm.netinfo.is_validator()
+    # Re-add node 3 (it kept its per-node key).
+    for i in (0, 1, 2):
+        net._process_step(
+            net.nodes[i], net.nodes[i].algorithm.vote_to_add(3, pk3)
+        )
+    for t in range(4):
+        for i in sorted(net.nodes):
+            net._process_step(
+                net.nodes[i], net.nodes[i].algorithm.push_transaction(("mid", t))
+            )
+    net.crank_until(
+        lambda n: all(
+            node.algorithm.dhb.era >= 2 for node in n.correct_nodes()
+        ),
+        max_cranks=5_000_000,
+    )
+    # Node 3 is a validator again and contributes.
+    assert net.nodes[3].algorithm.netinfo.is_validator()
+    for t in range(4):
+        for i in sorted(net.nodes):
+            net._process_step(
+                net.nodes[i], net.nodes[i].algorithm.push_transaction(("post", t))
+            )
+    target = {("post", t) for t in range(4)}
+    net.crank_until(
+        lambda n: all(
+            target <= set(committed_txs(node)) for node in n.correct_nodes()
+        ),
+        max_cranks=5_000_000,
+    )
+    orders = [committed_txs(node) for node in net.correct_nodes()]
+    assert all(o == orders[0] for o in orders)

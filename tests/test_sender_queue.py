@@ -1,0 +1,100 @@
+"""SenderQueue tests: epoch announcements gate delivery; consensus still
+works wrapped; premature messages are buffered, obsolete ones dropped."""
+
+import pytest
+
+from hbbft_tpu.net.adversary import ReorderingAdversary
+from hbbft_tpu.net.virtual_net import NetBuilder
+from hbbft_tpu.protocols.dynamic_honey_badger import DhbMessage, DynamicHoneyBadger
+from hbbft_tpu.protocols.honey_badger import HbMessage
+from hbbft_tpu.protocols.queueing_honey_badger import QueueingHoneyBadger
+from hbbft_tpu.protocols.sender_queue import SenderQueue, SqMessage
+
+
+def build(n, f=0, adversary=None, seed=0):
+    b = (
+        NetBuilder(range(n))
+        .num_faulty(f)
+        .crank_limit(10_000_000)
+        .using(
+            lambda ni, be, rng: SenderQueue(
+                QueueingHoneyBadger(
+                    ni, be, rng=rng, batch_size=3, session_id=b"test-sq"
+                )
+            )
+        )
+    )
+    if adversary:
+        b = b.adversary(adversary)
+    return b.build(seed=seed)
+
+
+def committed_txs(node):
+    out = []
+    for batch in node.outputs:
+        for p, txs in sorted(batch.contributions.items(), key=lambda kv: repr(kv[0])):
+            if isinstance(txs, list):
+                out.extend(tx for tx in txs if tx not in out)
+    return out
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_wrapped_qhb_commits(seed):
+    net = build(4, f=1, seed=seed)
+    txs = [("tx", i) for i in range(6)]
+    for tx in txs:
+        for i in sorted(net.nodes):
+            net._process_step(net.nodes[i], net.nodes[i].algorithm.push_transaction(tx))
+    net.crank_until(
+        lambda n: all(
+            set(txs) <= set(committed_txs(node)) for node in n.correct_nodes()
+        ),
+        max_cranks=2_000_000,
+    )
+    orders = [committed_txs(node) for node in net.correct_nodes()]
+    assert all(o == orders[0] for o in orders)
+
+
+def test_premature_messages_buffered_until_announcement():
+    net = build(4, seed=1)
+    sq = net.nodes[0].algorithm
+    # Peer 1 announces it is still at (era 0, epoch 0).
+    net._process_step(net.nodes[0], sq.handle_message(1, SqMessage.epoch_started(0, 0)))
+    # A far-future message for peer 1 must be buffered, not sent.
+    from hbbft_tpu.core.types import Step, Target, TargetedMessage
+
+    fake = DhbMessage(0, HbMessage.subset(10, "payload"))
+    step = sq._route(TargetedMessage(Target.node(1), fake))
+    assert step.messages == []
+    assert fake in sq._outgoing[1]
+    # Once the peer reaches epoch 8 (10 <= 8+3), the buffer flushes.
+    flush = sq._on_epoch_started(1, (0, 8))
+    sent = [tm for tm in flush.messages if tm.message.kind == "algo"]
+    assert len(sent) == 1 and sent[0].message.payload is fake
+
+
+def test_obsolete_messages_dropped():
+    net = build(4, seed=2)
+    sq = net.nodes[0].algorithm
+    net._process_step(net.nodes[0], sq.handle_message(1, SqMessage.epoch_started(2, 5)))
+    from hbbft_tpu.core.types import Target, TargetedMessage
+
+    stale = DhbMessage(0, HbMessage.subset(0, "old"))
+    step = sq._route(TargetedMessage(Target.node(1), stale))
+    assert step.messages == []  # dropped silently
+    assert not sq._outgoing.get(1)
+
+
+def test_announcements_are_emitted():
+    net = build(4, seed=3)
+    for i in sorted(net.nodes):
+        net._process_step(
+            net.nodes[i], net.nodes[i].algorithm.push_transaction(("t", i))
+        )
+    net.crank_until(
+        lambda n: all(len(node.outputs) >= 1 for node in n.correct_nodes()),
+        max_cranks=1_000_000,
+    )
+    # After the first batch, peers know each other's progress.
+    sq = net.nodes[0].algorithm
+    assert sq.peer_epochs, "no epoch announcements received"

@@ -1,0 +1,168 @@
+"""SyncKeyGen tests (reference: the worked doc-test in `src/sync_key_gen.rs` §
+plus `tests/sync_key_gen.rs` §): a full dealer-less key generation whose
+output keys actually sign/verify/combine, agreement on the public key set,
+and fault handling for corrupted parts/acks."""
+
+import random
+
+import pytest
+
+from hbbft_tpu.crypto.group import MockGroup
+from hbbft_tpu.crypto.keys import SecretKey
+from hbbft_tpu.protocols.sync_key_gen import Ack, Part, SyncKeyGen
+
+
+def run_dkg(n=4, threshold=1, seed=0, group=None, drop_proposer=None):
+    """Run a full synchronous DKG among n nodes; returns (pk_set, shares)."""
+    g = group or MockGroup()
+    rng = random.Random(seed)
+    sks = {i: SecretKey.random(g, rng) for i in range(n)}
+    pks = {i: sk.public_key() for i, sk in sks.items()}
+    nodes = {}
+    parts = {}
+    for i in range(n):
+        kg, part = SyncKeyGen.new(i, sks[i], pks, threshold, rng, g)
+        nodes[i] = kg
+        if part is not None and i != drop_proposer:
+            parts[i] = part
+    # Everyone handles every part, producing acks; everyone handles all acks.
+    acks = []
+    for proposer in sorted(parts):
+        for i in range(n):
+            out = nodes[i].handle_part(proposer, parts[proposer], rng)
+            assert out.fault is None, out.fault
+            if out.ack is not None:
+                acks.append((i, out.ack))
+    for acker, ack in acks:
+        for i in range(n):
+            out = nodes[i].handle_ack(acker, ack)
+            assert out.fault is None, out.fault
+    results = {i: nodes[i].generate() for i in range(n)}
+    pk_sets = {i: r[0] for i, r in results.items()}
+    shares = {i: r[1] for i, r in results.items()}
+    # All nodes derive the same public key set.
+    assert all(pk_sets[i] == pk_sets[0] for i in range(n))
+    return pk_sets[0], shares
+
+
+@pytest.mark.parametrize("n,t", [(4, 1), (7, 2), (4, 0)])
+def test_generated_keys_work(n, t):
+    pk_set, shares = run_dkg(n, t, seed=1)
+    assert pk_set.threshold() == t
+    doc = b"dkg doc"
+    sig_shares = {i: shares[i].sign_share(doc) for i in range(t + 1)}
+    for i in range(t + 1):
+        assert pk_set.public_key_share(i).verify_sig_share(sig_shares[i], doc)
+    sig = pk_set.combine_signatures(sig_shares)
+    assert pk_set.public_key().verify(sig, doc)
+    # Different subset combines to the same signature.
+    sig2 = pk_set.combine_signatures(
+        {i: shares[i].sign_share(doc) for i in range(n - t - 1, n)}
+    )
+    assert sig == sig2
+
+
+def test_generated_keys_encrypt():
+    pk_set, shares = run_dkg(4, 1, seed=2)
+    rng = random.Random(9)
+    msg = b"post-dkg secret"
+    ct = pk_set.encrypt(msg, rng)
+    dshares = {}
+    for i in (1, 3):
+        d = shares[i].decrypt_share(ct)
+        assert pk_set.public_key_share(i).verify_decryption_share(d, ct)
+        dshares[i] = d
+    assert pk_set.combine_decryption_shares(dshares, ct) == msg
+
+
+def test_dkg_tolerates_missing_proposer():
+    """One proposer never sends a Part; the other N-1 parts suffice."""
+    pk_set, shares = run_dkg(4, 1, seed=3, drop_proposer=2)
+    doc = b"x"
+    sig = pk_set.combine_signatures(
+        {i: shares[i].sign_share(doc) for i in (0, 2)}
+    )
+    assert pk_set.public_key().verify(sig, doc)
+
+
+def test_corrupt_part_rows_faulted():
+    g = MockGroup()
+    rng = random.Random(4)
+    sks = {i: SecretKey.random(g, rng) for i in range(4)}
+    pks = {i: sk.public_key() for i, sk in sks.items()}
+    kg0, _ = SyncKeyGen.new(0, sks[0], pks, 1, rng, g)
+    _, part1 = SyncKeyGen.new(1, sks[1], pks, 1, rng, g)
+    # Corrupt node 0's encrypted row.
+    rows = list(part1.rows)
+    rows[0] = rows[0][:-1] + bytes([rows[0][-1] ^ 1])
+    out = kg0.handle_part(1, Part(part1.commit, tuple(rows)), rng)
+    assert out.fault in (
+        "sync_key_gen:invalid_row_encryption",
+        "sync_key_gen:row_commitment_mismatch",
+    )
+
+
+def test_wrong_ack_value_faulted():
+    g = MockGroup()
+    rng = random.Random(5)
+    sks = {i: SecretKey.random(g, rng) for i in range(4)}
+    pks = {i: sk.public_key() for i, sk in sks.items()}
+    nodes = {}
+    parts = {}
+    for i in range(4):
+        kg, part = SyncKeyGen.new(i, sks[i], pks, 1, rng, g)
+        nodes[i] = kg
+        parts[i] = part
+    out0 = nodes[0].handle_part(1, parts[1], rng)
+    out2 = nodes[2].handle_part(1, parts[1], rng)
+    assert out0.ack and out2.ack
+    # Node 2 lies in its ack to node 0: re-encrypt a wrong value for slot 0.
+    from hbbft_tpu.utils import canonical
+
+    vals = list(out2.ack.values)
+    vals[0] = pks[0].encrypt(canonical.encode(12345), rng).to_bytes()
+    bad_ack = Ack(out2.ack.proposer_idx, tuple(vals))
+    assert nodes[0].handle_ack(2, bad_ack).fault == "sync_key_gen:ack_value_mismatch"
+    # An honest ack still passes.
+    assert nodes[0].handle_ack(0, out0.ack).fault is None
+
+
+def test_ack_before_part_is_buffered():
+    g = MockGroup()
+    rng = random.Random(6)
+    sks = {i: SecretKey.random(g, rng) for i in range(4)}
+    pks = {i: sk.public_key() for i, sk in sks.items()}
+    nodes = {}
+    parts = {}
+    for i in range(4):
+        kg, part = SyncKeyGen.new(i, sks[i], pks, 1, rng, g)
+        nodes[i] = kg
+        parts[i] = part
+    # Node 1 acks part 0; node 2 receives the ack *before* part 0.
+    ack = nodes[1].handle_part(0, parts[0], rng).ack
+    assert nodes[2].handle_ack(1, ack).fault is None  # buffered
+    assert nodes[2].handle_part(0, parts[0], rng).fault is None
+    assert 1 in nodes[2].parts[0].acks  # drained
+
+
+def test_not_ready_raises():
+    g = MockGroup()
+    rng = random.Random(7)
+    sks = {i: SecretKey.random(g, rng) for i in range(4)}
+    pks = {i: sk.public_key() for i, sk in sks.items()}
+    kg, _ = SyncKeyGen.new(0, sks[0], pks, 1, rng, g)
+    with pytest.raises(ValueError):
+        kg.generate()
+
+
+@pytest.mark.slow
+def test_dkg_on_real_curve():
+    from hbbft_tpu.crypto.bls381 import BLS381Group
+
+    pk_set, shares = run_dkg(4, 1, seed=8, group=BLS381Group())
+    doc = b"real curve dkg"
+    sig_shares = {i: shares[i].sign_share(doc) for i in (0, 3)}
+    for i in (0, 3):
+        assert pk_set.public_key_share(i).verify_sig_share(sig_shares[i], doc)
+    sig = pk_set.combine_signatures(sig_shares)
+    assert pk_set.public_key().verify(sig, doc)
