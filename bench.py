@@ -1,0 +1,56 @@
+"""Benchmark entry point — prints ONE JSON line for the driver.
+
+Current flagship metric: GF(2⁸) Reed–Solomon parity encode throughput on
+device (the broadcast hot op, BASELINE.json config 4 "RS-as-matmul") vs the
+numpy host codec baseline.  As the TPU crypto stack lands this will switch
+to the north-star metric (HBBFT epochs/sec at N=100,f=33).
+"""
+
+import json
+import time
+
+import numpy as np
+
+
+def bench_rs_encode() -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from hbbft_tpu.crypto.erasure import RSCodec
+    from hbbft_tpu.ops.gf256 import JaxRSCodec
+
+    k, m = 34, 66  # N=100, f=33 broadcast shape: k = N-2f data, 2f parity
+    L = 1 << 16  # bytes per shard
+    rng = np.random.default_rng(0)
+    mat = rng.integers(0, 256, size=(k, L), dtype=np.uint8)
+
+    dev = JaxRSCodec(k, m)
+    fn = jax.jit(dev.encode_matrix_fn())
+    x = jnp.asarray(mat)
+    fn(x).block_until_ready()  # compile
+    iters = 20
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(x)
+    out.block_until_ready()
+    dev_s = (time.perf_counter() - t0) / iters
+
+    host = RSCodec(k, m)
+    from hbbft_tpu.crypto.erasure import gf256
+
+    gf = gf256()
+    t0 = time.perf_counter()
+    gf.matmul(host.encode_matrix, mat)
+    host_s = time.perf_counter() - t0
+
+    mb = k * L / 1e6
+    return {
+        "metric": "rs_encode_throughput",
+        "value": round(mb / dev_s, 2),
+        "unit": "MB/s",
+        "vs_baseline": round(host_s / dev_s, 2),
+    }
+
+
+if __name__ == "__main__":
+    print(json.dumps(bench_rs_encode()))

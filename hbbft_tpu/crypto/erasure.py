@@ -112,13 +112,24 @@ class RSCodec:
         parity_pts = list(range(self.k, self.n))
         self.encode_matrix = _GF.lagrange_matrix(data_pts, parity_pts)
 
+    def _parity(self, mat: np.ndarray) -> np.ndarray:
+        """Hook: (k, L) data matrix → (m, L) parity matrix.  Device codecs
+        (hbbft_tpu/ops/gf256.py) override this with the TPU bit-matmul."""
+        return _GF.matmul(self.encode_matrix, mat)
+
+    def _interpolate(
+        self, xs: Sequence[int], missing: Sequence[int], stack: np.ndarray
+    ) -> np.ndarray:
+        """Hook: values at points ``xs`` (k×L) → values at ``missing``."""
+        return _GF.matmul(_GF.lagrange_matrix(list(xs), list(missing)), stack)
+
     def encode(self, data: bytes) -> List[bytes]:
         """Split ``data`` into k shards (zero-padded after a length prefix is
         the caller's concern) and append m parity shards."""
         shard_len = -(-len(data) // self.k) if data else 1
         padded = data.ljust(shard_len * self.k, b"\0")
         mat = np.frombuffer(padded, dtype=np.uint8).reshape(self.k, shard_len)
-        parity = _GF.matmul(self.encode_matrix, mat)
+        parity = self._parity(mat)
         return [mat[i].tobytes() for i in range(self.k)] + [
             parity[j].tobytes() for j in range(self.m)
         ]
@@ -139,8 +150,7 @@ class RSCodec:
         missing = [i for i, s in enumerate(shards) if s is None]
         out = list(shards)
         if missing:
-            mat = _GF.lagrange_matrix(xs, missing)
-            rec = _GF.matmul(mat, stack)
+            rec = self._interpolate(xs, missing, stack)
             for row, idx in enumerate(missing):
                 out[idx] = rec[row].tobytes()
         return [s if s is not None else b"" for s in out]

@@ -4,17 +4,25 @@ Multi-chip sharding tests run on a virtual 8-device CPU mesh: real
 multi-chip TPU hardware is not available in CI, so JAX is forced onto the
 host platform with 8 virtual devices (the driver separately dry-run-compiles
 the multi-chip path via __graft_entry__.dryrun_multichip).
+
+The ambient environment may register a remote-TPU PJRT plugin via a
+sitecustomize hook that imports jax at interpreter startup, so setting
+JAX_PLATFORMS via os.environ here is too late — the platform must be forced
+through jax.config instead (XLA_FLAGS is still read lazily at backend init).
 """
 
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
