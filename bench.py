@@ -1,56 +1,68 @@
 """Benchmark entry point — prints ONE JSON line for the driver.
 
-Current flagship metric: GF(2⁸) Reed–Solomon parity encode throughput on
-device (the broadcast hot op, BASELINE.json config 4 "RS-as-matmul") vs the
-numpy host codec baseline.  As the TPU crypto stack lands this will switch
-to the north-star metric (HBBFT epochs/sec at N=100,f=33).
+Flagship metric: **threshold-share verifications/sec** on device — each
+item is a full BLS12-381 pairing-equation check e(a1,b1)==e(a2,b2) done as
+two Miller loops + one shared (fast) final exponentiation, batched over the
+work-item axis (BASELINE.json: "threshold-decrypt shares verified/sec/chip"
+is the operative micro-metric; the O(N²) such checks per epoch are the
+whole HBBFT performance story, SURVEY.md §3.2).
+
+``vs_baseline`` compares against 1_000 checks/sec — the order-of-magnitude
+single-core CPU pairing throughput BASELINE.md's cost model assigns the
+Rust reference (its `threshold_crypto` crate; the repo itself publishes no
+numbers).
+
+The benched graph is `hbbft_tpu.ops.pairing.product2_fast` — the SAME
+kernel the TpuBackend dispatches, so the number is the framework's real
+verification path, not a proxy.
+
+Set BENCH_BATCH / BENCH_ITERS to override batch size and timing loops.
 """
 
 import json
+import os
 import time
 
-import numpy as np
+CPU_BASELINE_CHECKS_PER_SEC = 1_000.0
 
 
-def bench_rs_encode() -> dict:
+def bench_share_verify() -> dict:
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+    from hbbft_tpu.utils.jax_config import enable_compile_cache
+
+    enable_compile_cache()
     import jax
-    import jax.numpy as jnp
+    import numpy as np
 
-    from hbbft_tpu.crypto.erasure import RSCodec
-    from hbbft_tpu.ops.gf256 import JaxRSCodec
+    from hbbft_tpu.ops import pairing
 
-    k, m = 34, 66  # N=100, f=33 broadcast shape: k = N-2f data, 2f parity
-    L = 1 << 16  # bytes per shard
-    rng = np.random.default_rng(0)
-    mat = rng.integers(0, 256, size=(k, L), dtype=np.uint8)
+    batch = int(os.environ.get("BENCH_BATCH", "256"))
+    iters = int(os.environ.get("BENCH_ITERS", "3"))
 
-    dev = JaxRSCodec(k, m)
-    fn = jax.jit(dev.encode_matrix_fn())
-    x = jnp.asarray(mat)
-    fn(x).block_until_ready()  # compile
-    iters = 20
+    args = pairing.example_verify_batch(batch)
+    fn = jax.jit(pairing.product2_fast)
+    jax.block_until_ready(fn(*args))  # compile
     t0 = time.perf_counter()
     for _ in range(iters):
-        out = fn(x)
-    out.block_until_ready()
-    dev_s = (time.perf_counter() - t0) / iters
+        out = fn(*args)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / iters
 
-    host = RSCodec(k, m)
-    from hbbft_tpu.crypto.erasure import gf256
+    # Spot-check correctness of the benched computation.
+    f_host = jax.tree_util.tree_map(np.asarray, out)
+    assert pairing.is_one_host(f_host, 0), "benched verification is wrong"
 
-    gf = gf256()
-    t0 = time.perf_counter()
-    gf.matmul(host.encode_matrix, mat)
-    host_s = time.perf_counter() - t0
-
-    mb = k * L / 1e6
+    checks_per_sec = batch / dt
     return {
-        "metric": "rs_encode_throughput",
-        "value": round(mb / dev_s, 2),
-        "unit": "MB/s",
-        "vs_baseline": round(host_s / dev_s, 2),
+        "metric": "share_verify_throughput",
+        "value": round(checks_per_sec, 2),
+        "unit": "checks/s",
+        "vs_baseline": round(checks_per_sec / CPU_BASELINE_CHECKS_PER_SEC, 3),
     }
 
 
 if __name__ == "__main__":
-    print(json.dumps(bench_rs_encode()))
+    print(json.dumps(bench_share_verify()))

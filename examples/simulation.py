@@ -1,0 +1,230 @@
+"""Simulation benchmark CLI — the `examples/simulation.rs` equivalent.
+
+Runs N QueueingHoneyBadger nodes (wrapped in SenderQueue) over a simulated
+network with per-message latency λ + size/bandwidth delay and a CPU factor
+on message handling, then prints a per-epoch table and tx/s — the same
+vehicle the reference uses to measure itself (SURVEY.md §3.5).
+
+Virtual-time model (mirroring the reference's TestNode queues):
+
+* each node has a virtual clock; handling a message advances it by
+  cpu_factor · handling_cost;
+* a message sent at sender-time t arrives no earlier than
+  t + λ + size/bandwidth; the recipient processes it at
+  max(recipient_clock, arrival).
+
+Deferred crypto (CryptoWork) is accumulated and flushed in batches of
+``--crypto-window`` items so a device backend resolves whole windows in
+one dispatch — the SURVEY.md §7 round-barrier design in its virtual-time
+form.
+
+Usage:
+    python examples/simulation.py -n 10 -f 3 -b 100 --epochs 5
+    python examples/simulation.py -n 4 -f 1 --backend cpu   # real BLS, slow
+    python examples/simulation.py --backend tpu             # device batches
+"""
+
+from __future__ import annotations
+
+import argparse
+import heapq
+import os
+import pickle
+import random
+import sys
+import time
+from collections import defaultdict
+from typing import Any, Dict, List, Optional, Tuple
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from hbbft_tpu.core.network_info import NetworkInfo
+from hbbft_tpu.core.types import CryptoWork, Step
+from hbbft_tpu.crypto.backend import CpuBackend, MockBackend
+from hbbft_tpu.protocols.queueing_honey_badger import QueueingHoneyBadger
+from hbbft_tpu.protocols.sender_queue import SenderQueue
+
+
+def make_backend(name: str):
+    if name == "mock":
+        return MockBackend()
+    if name == "cpu":
+        return CpuBackend()
+    if name == "tpu":
+        from hbbft_tpu.ops.backend import TpuBackend
+
+        return TpuBackend()
+    raise ValueError(f"unknown backend {name!r}")
+
+
+class SimNode:
+    def __init__(self, nid: int, algo: SenderQueue) -> None:
+        self.id = nid
+        self.algo = algo
+        self.clock = 0.0  # virtual seconds
+        self.outputs: List[Any] = []
+        self.sent_msgs = 0
+
+
+class Simulation:
+    """Virtual-time event loop over N sans-I/O nodes."""
+
+    def __init__(self, args, backend, rng: random.Random) -> None:
+        self.args = args
+        self.backend = backend
+        self.rng = rng
+        ids = list(range(args.num_nodes))
+        netinfos = NetworkInfo.generate_map(ids, rng, backend)
+        self.nodes: Dict[int, SimNode] = {}
+        for nid in ids:
+            qhb = (
+                QueueingHoneyBadger.builder(netinfos[nid], backend, rng)
+                .batch_size(args.batch_size)
+                .session_id(b"simulation")
+                .build()
+            )
+            self.nodes[nid] = SimNode(nid, SenderQueue(qhb))
+        self.events: List[Tuple[float, int, int, int, Any]] = []  # (t, seq, to, frm, payload)
+        self._seq = 0
+        self.delivered = 0
+        self._pending_work: List[Tuple[int, CryptoWork]] = []
+
+    # -- plumbing ------------------------------------------------------------
+
+    def _msg_delay(self, payload: Any) -> float:
+        size = len(pickle.dumps(payload, protocol=4))
+        return self.args.lam / 1000.0 + size / (self.args.bandwidth * 1024.0)
+
+    def _emit(self, node: SimNode, step: Step) -> None:
+        node.outputs.extend(step.output)
+        for work in step.work:
+            self._pending_work.append((node.id, work))
+        all_ids = sorted(self.nodes)
+        for tm in step.messages:
+            for to in tm.target.recipients(all_ids, our_id=node.id):
+                self._seq += 1
+                node.sent_msgs += 1
+                t = node.clock + self._msg_delay(tm.message)
+                heapq.heappush(self.events, (t, self._seq, to, node.id, tm.message))
+
+    def _flush_work(self) -> None:
+        while self._pending_work:
+            batch, self._pending_work = self._pending_work, []
+            by_kind: Dict[str, List[Tuple[int, CryptoWork]]] = defaultdict(list)
+            for owner, w in batch:
+                by_kind[w.kind].append((owner, w))
+            for kind, items in by_kind.items():
+                payloads = [w.payload for _, w in items]
+                if kind == "verify_sig_share":
+                    results = self.backend.verify_sig_shares(payloads)
+                elif kind == "verify_dec_share":
+                    results = self.backend.verify_dec_shares(payloads)
+                elif kind == "verify_signature":
+                    results = self.backend.verify_signatures(payloads)
+                elif kind == "verify_ciphertext":
+                    results = self.backend.verify_ciphertexts(payloads)
+                else:
+                    raise RuntimeError(f"unknown work kind {kind!r}")
+                for (owner, w), res in zip(items, results):
+                    follow = w.on_result(res)
+                    if follow:
+                        self._emit(self.nodes[owner], follow)
+
+    # -- run -----------------------------------------------------------------
+
+    def run(self) -> List[dict]:
+        a = self.args
+        # Seed every node's queue with its share of transactions.
+        for nid, node in sorted(self.nodes.items()):
+            for k in range(a.txns):
+                tx = f"tx-{nid}-{k}-".encode() + bytes(a.tx_size)
+                self._emit(node, node.algo.handle_input(("user", tx), rng=self.rng))
+        self._flush_work()
+
+        target = a.epochs
+        rows = []
+        done_epochs = 0
+        wall0 = time.perf_counter()
+        while done_epochs < target:
+            if not self.events:
+                self._flush_work()
+                if not self.events:
+                    break
+            burst = 0
+            while self.events and burst < a.crypto_window:
+                t, _, to, frm, payload = heapq.heappop(self.events)
+                node = self.nodes[to]
+                node.clock = max(node.clock, t) + a.cpu_factor / 1000.0
+                self.delivered += 1
+                step = node.algo.handle_message(frm, payload, rng=self.rng)
+                self._emit(node, step)
+                burst += 1
+            self._flush_work()
+
+            min_epochs = min(len(n.outputs) for n in self.nodes.values())
+            while done_epochs < min_epochs:
+                batch = self.nodes[0].outputs[done_epochs]
+                vtime = max(n.clock for n in self.nodes.values())
+                txns = sum(len(c) for c in getattr(batch, "contributions", {}).values())
+                rows.append(
+                    {
+                        "epoch": done_epochs,
+                        "virtual_ms": round(vtime * 1000.0, 2),
+                        "wall_s": round(time.perf_counter() - wall0, 3),
+                        "txns": txns,
+                        "msgs": self.delivered,
+                    }
+                )
+                done_epochs += 1
+        return rows
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("-n", "--num-nodes", type=int, default=4)
+    p.add_argument("-f", "--num-faulty", type=int, default=1)
+    p.add_argument("-b", "--batch-size", type=int, default=100)
+    p.add_argument("-t", "--tx-size", type=int, default=10, help="bytes per txn payload")
+    p.add_argument("--txns", type=int, default=200, help="txns queued per node")
+    p.add_argument("--epochs", type=int, default=5)
+    p.add_argument("--lam", type=float, default=100.0, help="latency λ in ms")
+    p.add_argument("--bandwidth", type=float, default=2000.0, help="KB/s per link")
+    p.add_argument("--cpu-factor", type=float, default=1.0, help="handling cost ms")
+    p.add_argument("--crypto-window", type=int, default=64,
+                   help="messages handled between crypto batch flushes")
+    p.add_argument("--backend", choices=("mock", "cpu", "tpu"), default="mock")
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args(argv)
+
+    if args.num_nodes <= 3 * args.num_faulty:
+        p.error(f"N={args.num_nodes} cannot tolerate f={args.num_faulty} (need N>3f)")
+
+    rng = random.Random(args.seed)
+    backend = make_backend(args.backend)
+    sim = Simulation(args, backend, rng)
+    print(
+        f"hbbft_tpu simulation: N={args.num_nodes} f={args.num_faulty} "
+        f"batch={args.batch_size} backend={args.backend}"
+    )
+    rows = sim.run()
+    print(f"{'epoch':>6} {'virt ms':>10} {'wall s':>8} {'txns':>6} {'msgs':>8}")
+    total_tx = 0
+    for r in rows:
+        total_tx += r["txns"]
+        print(
+            f"{r['epoch']:>6} {r['virtual_ms']:>10} {r['wall_s']:>8} "
+            f"{r['txns']:>6} {r['msgs']:>8}"
+        )
+    if rows:
+        vt = rows[-1]["virtual_ms"] / 1000.0
+        wt = rows[-1]["wall_s"]
+        print(
+            f"total: {total_tx} txns in {len(rows)} epochs; "
+            f"{total_tx / vt if vt else 0:.1f} tx/s virtual; "
+            f"{len(rows) / wt if wt else 0:.2f} epochs/s wall"
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -57,7 +57,20 @@ class GF256:
         return int(self.EXP[255 - int(self.LOG[a])])
 
     def matmul(self, m: np.ndarray, x: np.ndarray) -> np.ndarray:
-        """GF(2⁸) matrix product: (r×k)·(k×L) with XOR accumulation."""
+        """GF(2⁸) matrix product: (r×k)·(k×L) with XOR accumulation.
+
+        Uses the native AVX2 kernel (hbbft_tpu/native) when the C toolchain
+        is available — the host analogue of the reference's SIMD
+        `reed-solomon-erasure` crate — else the numpy table path."""
+        from hbbft_tpu import native
+
+        got = native.gf256_matmul(m, x)
+        if got is not None:
+            return got
+        return self.matmul_numpy(m, x)
+
+    def matmul_numpy(self, m: np.ndarray, x: np.ndarray) -> np.ndarray:
+        """Pure-numpy reference path (golden check for the C kernel)."""
         m = np.asarray(m, dtype=np.uint8)
         x = np.asarray(x, dtype=np.uint8)
         out = np.zeros((m.shape[0], x.shape[1]), dtype=np.uint8)

@@ -1,0 +1,105 @@
+"""Native (C) host kernels, built on first use with graceful fallback.
+
+The reference leans on native Rust crates for its host-side hot math
+(`reed-solomon-erasure`, SURVEY.md §2.2).  Here the equivalent host kernels
+live in a small C file compiled at first import — `cc -O3 -march=native`
+into a cached shared object next to the source — and bound via ctypes (no
+pybind11 in this image).  If no toolchain is available the callers fall
+back to the numpy implementations transparently.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+from typing import Optional
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "gf256_kernel.c")
+_SO = os.path.join(_DIR, "_gf256_kernel.so")
+
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build() -> bool:
+    """(Re)build the shared object if missing or stale.  Returns success."""
+    try:
+        if os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
+            return True
+        # Unique temp output per process so concurrent builders can't
+        # publish each other's half-written object; os.replace is atomic.
+        tmp = f"{_SO}.{os.getpid()}.tmp"
+        for flags in (["-march=native"], []):  # fall back if -march trips
+            cmd = (
+                ["cc", "-O3", "-shared", "-fPIC"] + flags + ["-o", tmp, _SRC]
+            )
+            try:
+                subprocess.run(
+                    cmd, check=True, capture_output=True, timeout=120
+                )
+                os.replace(tmp, _SO)
+                return True
+            except (subprocess.CalledProcessError, subprocess.TimeoutExpired):
+                continue
+            finally:
+                if os.path.exists(tmp):
+                    try:
+                        os.remove(tmp)
+                    except OSError:
+                        pass
+        return False
+    except OSError:
+        return False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    _tried = True
+    if not _build():
+        return None
+    try:
+        lib = ctypes.CDLL(_SO)
+    except OSError:
+        return None
+    lib.gf256_init()
+    u8p = np.ctypeslib.ndpointer(dtype=np.uint8, flags="C_CONTIGUOUS")
+    lib.gf256_matmul.argtypes = [
+        u8p,
+        u8p,
+        u8p,
+        ctypes.c_long,
+        ctypes.c_long,
+        ctypes.c_long,
+    ]
+    lib.gf256_matmul.restype = None
+    lib.gf256_mul_elem.argtypes = [u8p, u8p, u8p, ctypes.c_long]
+    lib.gf256_mul_elem.restype = None
+    _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def gf256_matmul(m: np.ndarray, x: np.ndarray) -> Optional[np.ndarray]:
+    """(r×k)·(k×L) GF(2⁸) product via the C kernel, or None if unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    m = np.ascontiguousarray(m, dtype=np.uint8)
+    x = np.ascontiguousarray(x, dtype=np.uint8)
+    r, k = m.shape
+    k2, L = x.shape
+    if k != k2:
+        raise ValueError("shape mismatch")
+    out = np.empty((r, L), dtype=np.uint8)
+    lib.gf256_matmul(m, x, out, r, k, L)
+    return out

@@ -1,0 +1,100 @@
+/* GF(2^8) Reed-Solomon matmul kernel (host side).
+ *
+ * Native equivalent of the reference's `reed-solomon-erasure` Rust crate
+ * (SURVEY.md 2.2): the host-path hot op behind broadcast encode/decode.
+ * The TPU path (hbbft_tpu/ops/gf256.py) handles device batches; this file
+ * serves the VirtualNet runtime's host-side shard work.
+ *
+ * Strategy: the classic SIMD nibble-split.  For multiplier constant c the
+ * product c*x factors through x's nibbles:  c*x = LO_c[x & 15] ^ HI_c[x >> 4]
+ * (GF addition is XOR and the nibble decomposition is linear).  With AVX2 the
+ * two 16-entry tables live in a 256-bit register and PSHUFB resolves 32 bytes
+ * per shuffle.  Scalar fallback uses a 64KB full product table.
+ *
+ * Field: poly 0x11D, generator 2 - matching crypto/erasure.py.
+ */
+
+#include <stdint.h>
+#include <stddef.h>
+#include <string.h>
+
+#if defined(__AVX2__)
+#include <immintrin.h>
+#endif
+
+#define POLY 0x11D
+
+static uint8_t MUL[256][256];
+static uint8_t NIB_LO[256][16]; /* NIB_LO[c][n] = c * n        */
+static uint8_t NIB_HI[256][16]; /* NIB_HI[c][n] = c * (n << 4) */
+static int READY = 0;
+
+static uint8_t gf_mul_slow(uint32_t a, uint32_t b) {
+    uint32_t r = 0;
+    while (b) {
+        if (b & 1) r ^= a;
+        a <<= 1;
+        if (a & 0x100) a ^= POLY;
+        b >>= 1;
+    }
+    return (uint8_t)r;
+}
+
+void gf256_init(void) {
+    if (READY) return;
+    for (int a = 0; a < 256; a++)
+        for (int b = 0; b < 256; b++)
+            MUL[a][b] = gf_mul_slow((uint32_t)a, (uint32_t)b);
+    for (int c = 0; c < 256; c++)
+        for (int n = 0; n < 16; n++) {
+            NIB_LO[c][n] = MUL[c][n];
+            NIB_HI[c][n] = MUL[c][n << 4];
+        }
+    READY = 1;
+}
+
+/* dst[0..len) ^= c * src[0..len) */
+static void mul_acc_row(uint8_t *dst, const uint8_t *src, uint8_t c, size_t len) {
+    size_t t = 0;
+    if (c == 0) return;
+#if defined(__AVX2__)
+    if (len >= 32) {
+        const __m128i lo128 = _mm_loadu_si128((const __m128i *)NIB_LO[c]);
+        const __m128i hi128 = _mm_loadu_si128((const __m128i *)NIB_HI[c]);
+        const __m256i lo_tbl = _mm256_broadcastsi128_si256(lo128);
+        const __m256i hi_tbl = _mm256_broadcastsi128_si256(hi128);
+        const __m256i mask = _mm256_set1_epi8(0x0F);
+        for (; t + 32 <= len; t += 32) {
+            __m256i x = _mm256_loadu_si256((const __m256i *)(src + t));
+            __m256i xl = _mm256_and_si256(x, mask);
+            __m256i xh = _mm256_and_si256(_mm256_srli_epi16(x, 4), mask);
+            __m256i p = _mm256_xor_si256(
+                _mm256_shuffle_epi8(lo_tbl, xl),
+                _mm256_shuffle_epi8(hi_tbl, xh));
+            __m256i d = _mm256_loadu_si256((const __m256i *)(dst + t));
+            _mm256_storeu_si256((__m256i *)(dst + t), _mm256_xor_si256(d, p));
+        }
+    }
+#endif
+    {
+        const uint8_t *row = MUL[c];
+        for (; t < len; t++) dst[t] ^= row[src[t]];
+    }
+}
+
+/* out(r x L) = m(r x k) * x(k x L) over GF(2^8). */
+void gf256_matmul(const uint8_t *m, const uint8_t *x, uint8_t *out,
+                  long rows, long cols, long len) {
+    if (!READY) gf256_init();
+    memset(out, 0, (size_t)rows * (size_t)len);
+    for (long i = 0; i < rows; i++)
+        for (long j = 0; j < cols; j++)
+            mul_acc_row(out + (size_t)i * len, x + (size_t)j * len,
+                        m[(size_t)i * cols + j], (size_t)len);
+}
+
+/* Elementwise c = a * b over GF(2^8). */
+void gf256_mul_elem(const uint8_t *a, const uint8_t *b, uint8_t *c, long n) {
+    if (!READY) gf256_init();
+    for (long i = 0; i < n; i++) c[i] = MUL[a[i]][b[i]];
+}

@@ -1,0 +1,226 @@
+"""TpuBackend — batched device crypto behind the CryptoBackend seam.
+
+This is BASELINE.json's north star made concrete: protocols (and the
+VirtualNet crank loop) hand whole *batches* of pairing checks and share
+combinations to this backend, which resolves them in a handful of jitted
+device dispatches instead of per-share host loops (SURVEY.md §3.2: the
+O(N²) pairing verifies per node per epoch are the entire performance
+story).
+
+Every verification equation in the framework has the shape
+``e(a1, b1) == e(a2, b2)``, i.e. ``FE(ML(a1, b1)·ML(−a2, b2)) == 1``:
+
+* sig share:    e(G1, σ_i)  == e(PK_i, H2(doc))      (keys.py conventions)
+* full sig:     e(G1, σ)    == e(PK, H2(msg))
+* dec share:    e(D_i, H)   == e(PK_i, W)
+* ciphertext:   e(G1, W)    == e(U, H)
+
+So ONE jitted kernel — two batched Miller loops + one shared final
+exponentiation — serves all four batch-verify entry points.  Batches are
+padded to power-of-two buckets with trivially-true items (e(G,H)==e(G,H))
+so XLA compiles a handful of shapes, once each.
+
+Hash-to-curve and canonical equality run host-side: hashing is not the
+dominant cost (SURVEY.md §2.2) and host comparison removes every
+sequential carry chain from the device graph (ops/fq.py).
+
+Combines (Lagrange in the exponent) run on device above a batch-size
+threshold via the fixed-ladder MSM in ops/curve.py, else on the host
+golden path — share counts are small at small N and the 254-step ladder
+only pays for itself in bulk.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+
+from hbbft_tpu.crypto.backend import CryptoBackend
+from hbbft_tpu.crypto.bls381 import BLS381Group
+from hbbft_tpu.crypto.field import lagrange_coeffs_at_zero
+from hbbft_tpu.crypto.keys import (
+    Ciphertext,
+    CryptoError,
+    DecryptionShare,
+    PublicKeySet,
+    PublicKeyShare,
+    Signature,
+    SignatureShare,
+)
+from hbbft_tpu.ops import curve, pairing, tower
+
+_MIN_BUCKET = 4
+
+
+def _bucket(n: int) -> int:
+    b = _MIN_BUCKET
+    while b < n:
+        b <<= 1
+    return b
+
+
+@functools.lru_cache(maxsize=None)
+def _jitted_product2():
+    """(P1, Q1, P2, Q2) → fq12 limbs of FE_fast(ML(P1,Q1)·ML(P2,Q2))."""
+    return jax.jit(pairing.product2_fast)
+
+
+@functools.lru_cache(maxsize=None)
+def _jitted_combine_g1():
+    return jax.jit(curve.linear_combine_g1)
+
+
+@functools.lru_cache(maxsize=None)
+def _jitted_combine_g2():
+    return jax.jit(curve.linear_combine_g2)
+
+
+class TpuBackend(CryptoBackend):
+    """JAX/TPU batched BLS12-381 backend.
+
+    Protocol-visible semantics are identical to CpuBackend (same golden
+    group for key material, hashing and serialization); only the batch
+    verify/combine paths move to the device.
+    """
+
+    #: combine on device only when at least this many shares are batched
+    device_combine_threshold = 8
+
+    def __init__(self) -> None:
+        super().__init__(BLS381Group())
+        self._h2_cache: Dict[bytes, Any] = {}
+
+    # -- internals -----------------------------------------------------------
+
+    def _hash_g2(self, doc: bytes):
+        h = self._h2_cache.get(doc)
+        if h is None:
+            h = self.group.hash_to_g2(doc)
+            if len(self._h2_cache) > 4096:
+                self._h2_cache.clear()
+            self._h2_cache[doc] = h
+        return h
+
+    def _check_batch(self, quads) -> List[bool]:
+        """quads: list of (a1, b1, a2, b2) affine tuples checking
+        e(a1,b1) == e(a2,b2).  Returns per-item booleans."""
+        n = len(quads)
+        if n == 0:
+            return []
+        g1 = self.group.g1()
+        g2 = self.group.g2()
+        pad = (g1, g2, g1, g2)  # trivially true
+        b = _bucket(n)
+        quads = list(quads) + [pad] * (b - n)
+
+        neg = self.group.g1_neg
+        P1 = pairing.g1_affine_to_device([q[0] for q in quads])
+        Q1 = pairing.g2_affine_to_device([q[1] for q in quads])
+        P2 = pairing.g1_affine_to_device(
+            [neg(q[2]) if q[2] is not None else None for q in quads]
+        )
+        Q2 = pairing.g2_affine_to_device([q[3] for q in quads])
+
+        f = _jitted_product2()(P1, Q1, P2, Q2)
+        f = jax.tree_util.tree_map(np.asarray, f)
+        return [pairing.is_one_host(f, i) for i in range(n)]
+
+    # -- batched verification ------------------------------------------------
+
+    def verify_sig_shares(
+        self, items: Sequence[Tuple[PublicKeyShare, bytes, SignatureShare]]
+    ) -> List[bool]:
+        g1 = self.group.g1()
+        quads = [
+            (g1, share.el, pk.el, self._hash_g2(doc))
+            for pk, doc, share in items
+        ]
+        return self._check_batch(quads)
+
+    def verify_signatures(
+        self, items: Sequence[Tuple[Any, bytes, Signature]]
+    ) -> List[bool]:
+        g1 = self.group.g1()
+        quads = [
+            (g1, sig.el, pk.el, self._hash_g2(msg)) for pk, msg, sig in items
+        ]
+        return self._check_batch(quads)
+
+    def verify_dec_shares(
+        self, items: Sequence[Tuple[PublicKeyShare, Ciphertext, DecryptionShare]]
+    ) -> List[bool]:
+        quads = []
+        for pk, ct, share in items:
+            h = self._hash_g2(self.group.g1_to_bytes(ct.u) + ct.v)
+            quads.append((share.el, h, pk.el, ct.w))
+        return self._check_batch(quads)
+
+    def verify_ciphertexts(self, items: Sequence[Ciphertext]) -> List[bool]:
+        g1 = self.group.g1()
+        quads = []
+        for ct in items:
+            h = self._hash_g2(self.group.g1_to_bytes(ct.u) + ct.v)
+            quads.append((g1, ct.w, ct.u, h))
+        return self._check_batch(quads)
+
+    # -- combination ---------------------------------------------------------
+
+    def _lagrange_device(
+        self, pts: List[Tuple[int, Any]], to_device, from_device, jitted
+    ):
+        """Shared padding/bucketing for device Lagrange combines.
+
+        Pads with infinity points and zero scalars (0·∞ contributes the
+        identity) up to a power-of-two bucket so XLA compiles few shapes.
+        """
+        lam = lagrange_coeffs_at_zero([x for x, _ in pts])
+        safe = [curve.safe_scalar(l) for l in lam]
+        b = _bucket(len(pts))
+        points = [el for _, el in pts] + [None] * (b - len(pts))
+        bits = curve.scalars_to_bits(
+            [s for s, _ in safe] + [0] * (b - len(pts))
+        )
+        negs = np.array([n for _, n in safe] + [False] * (b - len(pts)))
+        combined = jitted(to_device(points), bits, negs)
+        return from_device(combined)[0]
+
+    def _lagrange_device_g2(self, pts: List[Tuple[int, Any]]):
+        return self._lagrange_device(
+            pts, curve.g2_to_device, curve.g2_from_device, _jitted_combine_g2()
+        )
+
+    def _lagrange_device_g1(self, pts: List[Tuple[int, Any]]):
+        return self._lagrange_device(
+            pts, curve.g1_to_device, curve.g1_from_device, _jitted_combine_g1()
+        )
+
+    def combine_signatures(
+        self, pk_set: PublicKeySet, shares: Dict[int, SignatureShare]
+    ) -> Signature:
+        if len(shares) <= pk_set.threshold():
+            raise CryptoError(
+                f"need {pk_set.threshold() + 1} shares, got {len(shares)}"
+            )
+        if len(shares) < self.device_combine_threshold:
+            return pk_set.combine_signatures(shares)
+        pts = [(i + 1, s.el) for i, s in sorted(shares.items())]
+        return Signature(self.group, self._lagrange_device_g2(pts))
+
+    def combine_decryption_shares(
+        self, pk_set: PublicKeySet, shares: Dict[int, DecryptionShare], ct: Ciphertext
+    ) -> bytes:
+        if len(shares) <= pk_set.threshold():
+            raise CryptoError(
+                f"need {pk_set.threshold() + 1} shares, got {len(shares)}"
+            )
+        if len(shares) < self.device_combine_threshold:
+            return pk_set.combine_decryption_shares(shares, ct)
+        pts = [(i + 1, s.el) for i, s in sorted(shares.items())]
+        combined = self._lagrange_device_g1(pts)
+        g = self.group
+        pad = g.hash_bytes(g.g1_to_bytes(combined), len(ct.v))
+        return bytes(a ^ b for a, b in zip(ct.v, pad))

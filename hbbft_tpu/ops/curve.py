@@ -1,0 +1,356 @@
+"""Batched G1/G2 elliptic-curve arithmetic in Jacobian coordinates.
+
+One generic implementation serves both groups: G1 over Fq and G2 over Fq2,
+parameterized by a tiny field-ops namespace (the same move the golden
+reference makes with its `_Fld` vtable — crypto/bls381.py).
+
+Design constraints from the TPU mapping (SURVEY.md §7):
+
+* **No in-graph zero tests.**  Infinity is an explicit boolean lane carried
+  next to (X, Y, Z); all formulas are total and results are `select`ed.
+* **Fixed control flow.**  Scalar multiplication is a 254-iteration
+  MSB-first double-and-add-always ladder under `lax.scan` — one compiled
+  graph for every scalar, batch-friendly, constant-time by construction.
+* **Unequal-add only.**  The Jacobian add assumes P ≠ ±Q for finite
+  operands.  Inside the ladder acc = 2m·P meets ±P only when 2m ≡ ±1
+  (mod r), which is impossible for scalars < 2^254 (see `safe_scalar`) —
+  the degenerate case is structurally excluded, not probabilistically.
+  For share combination the added points are distinct verified shares whose
+  discrete logs were fixed before the (public) Lagrange coefficients were
+  known, so an accidental ±collision has cryptographically negligible
+  probability; signature combines are additionally re-verified against the
+  master public key by the backend (defense in depth with CPU fallback).
+
+Reference analogue: group ops inside `threshold_crypto`'s `pairing` crate
+(SURVEY.md §2.2) — serial Rust there, batched limb vectors here.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, NamedTuple, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from hbbft_tpu.crypto.field import R
+from hbbft_tpu.ops import fq, tower
+
+SCALAR_BITS = 254  # scalars are screened to < 2^254 (see safe_scalar)
+
+
+# ---------------------------------------------------------------------------
+# Field-ops namespaces
+# ---------------------------------------------------------------------------
+
+
+class _F1:
+    """Fq ops (G1 coordinate field)."""
+
+    add = staticmethod(fq.add)
+    sub = staticmethod(fq.sub)
+    neg = staticmethod(fq.neg)
+    mul = staticmethod(fq.mul)
+    sqr = staticmethod(fq.sqr)
+    mul_many = staticmethod(fq.mul_n)
+    select = staticmethod(fq.select)
+
+    @staticmethod
+    def zeros_like(x):
+        return jnp.zeros_like(jnp.asarray(x))
+
+    @staticmethod
+    def one_like(x):
+        x = jnp.asarray(x)
+        return jnp.broadcast_to(jnp.asarray(fq.ONE), x.shape)
+
+
+class _F2:
+    """Fq2 ops (G2 coordinate field)."""
+
+    add = staticmethod(tower.fq2_add)
+    sub = staticmethod(tower.fq2_sub)
+    neg = staticmethod(tower.fq2_neg)
+    mul = staticmethod(tower.fq2_mul)
+    sqr = staticmethod(tower.fq2_sqr)
+    mul_many = staticmethod(tower.fq2_mul_many)
+    select = staticmethod(tower.fq2_select)
+
+    @staticmethod
+    def zeros_like(x):
+        return tuple(jnp.zeros_like(jnp.asarray(c)) for c in x)
+
+    @staticmethod
+    def one_like(x):
+        return tuple(
+            jnp.broadcast_to(jnp.asarray(c), jnp.asarray(x[0]).shape)
+            for c in tower.FQ2_ONE
+        )
+
+
+# ---------------------------------------------------------------------------
+# Jacobian point ops.  A point is (X, Y, Z, inf) with inf a bool array over
+# the batch shape.  (X : Y : Z) is valid only where ~inf.
+# ---------------------------------------------------------------------------
+
+
+def jac_double(F, P):
+    # Staged so every stage's independent products share one stacked multiply
+    # (compile-time: 3 dots instead of 7 — see fq.mul_n).
+    X, Y, Z, inf = P
+    A, B, YZ = F.mul_many([(X, X), (Y, Y), (Y, Z)])
+    E = F.add(F.add(A, A), A)  # 3A
+    C, t, Fv = F.mul_many([(B, B), (F.add(X, B), F.add(X, B)), (E, E)])
+    D = F.add(F.sub(F.sub(t, A), C), F.sub(F.sub(t, A), C))  # 2((X+B)²−A−C)
+    X3 = F.sub(Fv, F.add(D, D))
+    C4 = F.add(F.add(C, C), F.add(C, C))
+    C8 = F.add(C4, C4)
+    (EDX3,) = F.mul_many([(E, F.sub(D, X3))])
+    Y3 = F.sub(EDX3, C8)
+    Z3 = F.add(YZ, YZ)
+    return (X3, Y3, Z3, inf)
+
+
+def jac_add(F, P, Qp):
+    """Unequal add (P ≠ ±Q where both finite); infinity handled by select."""
+    X1, Y1, Z1, inf1 = P
+    X2, Y2, Z2, inf2 = Qp
+    Z1Z1, Z2Z2, Y1Z2, Y2Z1, Z1Z2 = F.mul_many(
+        [(Z1, Z1), (Z2, Z2), (Y1, Z2), (Y2, Z1), (Z1, Z2)]
+    )
+    U1, U2, S1, S2 = F.mul_many(
+        [(X1, Z2Z2), (X2, Z1Z1), (Y1Z2, Z2Z2), (Y2Z1, Z1Z1)]
+    )
+    H = F.sub(U2, U1)
+    Rr = F.sub(S2, S1)
+    H2, Z3 = F.mul_many([(H, H), (Z1Z2, H)])
+    H3, U1H2, R2 = F.mul_many([(H, H2), (U1, H2), (Rr, Rr)])
+    X3 = F.sub(F.sub(R2, H3), F.add(U1H2, U1H2))
+    RY, S1H3 = F.mul_many([(Rr, F.sub(U1H2, X3)), (S1, H3)])
+    Y3 = F.sub(RY, S1H3)
+
+    # inf1 → Q ; inf2 → P ; both → inf
+    X3 = F.select(inf1, X2, F.select(inf2, X1, X3))
+    Y3 = F.select(inf1, Y2, F.select(inf2, Y1, Y3))
+    Z3 = F.select(inf1, Z2, F.select(inf2, Z1, Z3))
+    return (X3, Y3, Z3, inf1 & inf2)
+
+
+def jac_neg(F, P):
+    X, Y, Z, inf = P
+    return (X, F.neg(Y), Z, inf)
+
+
+def infinity_like(F, P):
+    X, Y, Z, inf = P
+    return (
+        F.zeros_like(X),
+        F.one_like(Y),
+        F.zeros_like(Z),
+        jnp.ones_like(inf),
+    )
+
+
+def jac_select(F, cond, P, Qp):
+    return (
+        F.select(cond, P[0], Qp[0]),
+        F.select(cond, P[1], Qp[1]),
+        F.select(cond, P[2], Qp[2]),
+        jnp.where(cond, P[3], Qp[3]),
+    )
+
+
+def scalar_mul(F, bits: jnp.ndarray, P):
+    """MSB-first ladder: bits shape (..., SCALAR_BITS) over batch shape.
+
+    Scalars must be pre-screened by `safe_scalar` (< 2^254, no ±1 prefix).
+    """
+    acc = infinity_like(F, P)
+
+    def step(acc, bit):
+        acc = jac_double(F, acc)
+        cand = jac_add(F, acc, P)
+        cond = bit.astype(bool)
+        return jac_select(F, cond, cand, acc), None
+
+    # scan over the bit axis: move it to the front.
+    xs = jnp.moveaxis(bits, -1, 0)
+    acc, _ = jax.lax.scan(step, acc, xs)
+    return acc
+
+
+# ---------------------------------------------------------------------------
+# Host-side scalar preparation
+# ---------------------------------------------------------------------------
+
+
+def safe_scalar(s: int) -> Tuple[int, bool]:
+    """Return (s', negate) with s ≡ ±s' (mod r) and s' < 2^254.
+
+    Why that bound makes the ladder safe: a selected add step computes
+    acc + P with acc = 2m·P, where the pre-step prefix m has ≤ 253 bits.
+    The unequal-add degenerate case needs 2m ≡ ±1 (mod r); but
+    2m < 2^254 < r − 1, so 2m can be neither 1 (it's even and > 0 when it
+    matters) nor r − 1.  Since r > 2^254.8, at least one of s, r − s is
+    always < 2^254.
+    """
+    s %= R
+    if not (s >> SCALAR_BITS):
+        return (s, False)
+    return (R - s, True)
+
+
+def scalars_to_bits(scalars: Sequence[int]) -> np.ndarray:
+    """(B, SCALAR_BITS) MSB-first bit matrix (host)."""
+    out = np.zeros((len(scalars), SCALAR_BITS), dtype=np.int32)
+    for i, s in enumerate(scalars):
+        if s >> SCALAR_BITS:
+            raise ValueError("scalar too large — run safe_scalar first")
+        for j in range(SCALAR_BITS):
+            out[i, SCALAR_BITS - 1 - j] = (s >> j) & 1
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Host <-> device point conversion
+# ---------------------------------------------------------------------------
+
+
+def g1_to_device(points: Sequence[Optional[Tuple[int, int]]]):
+    """Affine G1 points (golden-ref (x, y) ints or None) → batched Jacobian."""
+    n = len(points)
+    xs = fq.from_ints([(p[0] if p else 0) for p in points])
+    ys = fq.from_ints([(p[1] if p else 1) for p in points])
+    zs = np.stack([np.asarray(fq.ZERO if p is None else fq.ONE) for p in points])
+    inf = np.array([p is None for p in points])
+    return (jnp.asarray(xs), jnp.asarray(ys), jnp.asarray(zs), jnp.asarray(inf))
+
+
+def g2_to_device(points):
+    """Affine G2 points (((x0,x1),(y0,y1)) or None) → batched Jacobian."""
+    X = tower.fq2_stack([(p[0] if p else (0, 0)) for p in points])
+    Y = tower.fq2_stack([(p[1] if p else (1, 0)) for p in points])
+    z_rows = [
+        ((1, 0) if p is not None else (0, 0)) for p in points
+    ]
+    Z = tower.fq2_stack(z_rows)
+    inf = np.array([p is None for p in points])
+    return (
+        tuple(jnp.asarray(c) for c in X),
+        tuple(jnp.asarray(c) for c in Y),
+        tuple(jnp.asarray(c) for c in Z),
+        jnp.asarray(inf),
+    )
+
+
+def g1_from_device(P) -> List[Optional[Tuple[int, int]]]:
+    """Batched Jacobian G1 → affine int tuples (host; exact)."""
+    X, Y, Z, inf = P
+    X, Y, Z = np.asarray(X), np.asarray(Y), np.asarray(Z)
+    inf = np.asarray(inf)
+    from hbbft_tpu.crypto.field import Q
+
+    out: List[Optional[Tuple[int, int]]] = []
+    for i in range(X.shape[0]):
+        if inf[i]:
+            out.append(None)
+            continue
+        z = fq.to_int(Z[i])
+        if z == 0:
+            out.append(None)
+            continue
+        zi = pow(z, -1, Q)
+        x = (fq.to_int(X[i]) * zi * zi) % Q
+        y = (fq.to_int(Y[i]) * zi * zi * zi) % Q
+        out.append((x, y))
+    return out
+
+
+def g2_from_device(P):
+    """Batched Jacobian G2 → affine ((x0,x1),(y0,y1)) tuples (host; exact)."""
+    from hbbft_tpu.crypto import bls381 as gold
+
+    X, Y, Z, inf = P
+    inf = np.asarray(inf)
+    out = []
+    for i in range(np.asarray(X[0]).shape[0]):
+        if inf[i]:
+            out.append(None)
+            continue
+        z = tower.fq2_to_ints(Z, i)
+        if z == (0, 0):
+            out.append(None)
+            continue
+        zi = gold.fq2_inv(z)
+        zi2 = gold.fq2_sqr(zi)
+        zi3 = gold.fq2_mul(zi2, zi)
+        x = gold.fq2_mul(tower.fq2_to_ints(X, i), zi2)
+        y = gold.fq2_mul(tower.fq2_to_ints(Y, i), zi3)
+        out.append((x, y))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Batched scalar-mul + linear combination (the share-combine kernel)
+# ---------------------------------------------------------------------------
+
+
+def g1_scalar_mul_batch(points, bits):
+    """points: batched Jacobian G1 (B, ...), bits (B, 254) → batched m·P."""
+    return scalar_mul(_F1, jnp.asarray(bits), points)
+
+
+def g2_scalar_mul_batch(points, bits):
+    return scalar_mul(_F2, jnp.asarray(bits), points)
+
+
+def _tree_sum(F, P, axis_len: int):
+    """Reduce a batch of points (leading axis) to their sum by halving."""
+    n = axis_len
+    while n > 1:
+        half = n // 2
+        a = tuple(
+            jax.tree_util.tree_map(lambda c: c[:half], P[k]) for k in range(3)
+        ) + (P[3][:half],)
+        b = tuple(
+            jax.tree_util.tree_map(lambda c: c[half : 2 * half], P[k])
+            for k in range(3)
+        ) + (P[3][half : 2 * half],)
+        summed = jac_add(F, a, b)
+        if n % 2:
+            tail = tuple(
+                jax.tree_util.tree_map(lambda c: c[-1:], P[k]) for k in range(3)
+            ) + (P[3][-1:],)
+            summed = (
+                tuple(
+                    jax.tree_util.tree_map(
+                        lambda s, t: jnp.concatenate([s, t], axis=0), summed[k], tail[k]
+                    )
+                    for k in range(3)
+                )
+                + (jnp.concatenate([summed[3], tail[3]], axis=0),)
+            )
+            n = half + 1
+        else:
+            n = half
+        P = summed
+    return P
+
+
+def linear_combine_g1(points, bits, negs):
+    """Σ ±(bits_i · P_i) over the leading axis → single Jacobian point.
+
+    `negs` is a (B,) bool array applying the safe_scalar negation.
+    """
+    prods = g1_scalar_mul_batch(points, bits)
+    prods = jac_select(
+        _F1, jnp.asarray(negs), jac_neg(_F1, prods), prods
+    )
+    return _tree_sum(_F1, prods, jnp.shape(bits)[0])
+
+
+def linear_combine_g2(points, bits, negs):
+    prods = g2_scalar_mul_batch(points, bits)
+    prods = jac_select(_F2, jnp.asarray(negs), jac_neg(_F2, prods), prods)
+    return _tree_sum(_F2, prods, jnp.shape(bits)[0])

@@ -1,0 +1,266 @@
+"""Batched BLS12-381 base-field (Fq) limb arithmetic for TPU.
+
+This is the foundation of the device crypto stack (SURVEY.md §7 "hard parts"
+item 1): 381-bit field elements as vectors of **37 limbs × 11 bits** held in
+``int32`` — the widest limb for which a full 37-term schoolbook convolution
+plus reduction fits signed-int32 accumulators with headroom:
+
+    products  ≤ (2^11+ε)^2            ≈ 2^22
+    conv sum  ≤ 37 · 2^22             ≈ 2^27.3   < 2^31  ✓
+    fold sum  ≤ 38 · 2^11 · 2^11.7    ≈ 2^28     < 2^31  ✓
+
+Representation ("lazy residue"):
+
+* An element is any int32 vector ``l[0..36]`` whose value Σ l_i·2^(11i) is
+  congruent to the represented element mod Q.  Limbs may be negative
+  (subtraction never borrows; signs ride along) and the value may exceed Q —
+  reduction keeps |value| < 2^394 ≈ 2^13·Q, and every op tolerates inputs
+  with |value| up to ~2^398 (a dozen chained lazy adds); vectors outside
+  that envelope (e.g. all 37 limbs at MASK ⇒ 2^407) are out of domain.
+* ``carry3`` renormalizes limbs to [-1, 2^11+1) in three data-independent
+  vector passes (no sequential scan — carries shrink geometrically from the
+  2^28 bound).  The TOP limb is never split, so no carry is ever dropped.
+* There is deliberately **no canonical reduction on device**: protocols need
+  booleans and byte-strings only at the host seam, where ``to_int`` does an
+  exact Python-int mod-Q.  This removes every sequential carry chain from
+  the jitted graph (SURVEY.md §7 hard part 6: fixed reduction orders).
+
+Multiplication is convolution expressed as one gather + one small matmul:
+``Bmat[i,k] = b[k-i]`` (37×73, built with a precomputed index/mask pair),
+then ``c = a @ Bmat`` — XLA turns the batch of these into large int32
+dot-generals, the MXU/VPU-friendly shape the whole design targets.
+
+Reduction mod Q folds limbs ≥ 35 through precomputed rows
+``FOLD[j] = limbs(2^(11·(35+j)) mod Q)`` — again a matmul.  Two fold rounds
+bring any 73-limb convolution back to the 37-limb lazy invariant.
+
+Reference analogue: the `ff`/`pairing` crates' 64-bit limb arithmetic under
+`threshold_crypto` (SURVEY.md §2.2) — redesigned for a carry-less SIMD ISA
+instead of scalar add-with-carry.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from hbbft_tpu.crypto.field import Q
+
+BITS = 11
+BASE = 1 << BITS
+MASK = BASE - 1
+NLIMBS = 37  # 37·11 = 407 bits capacity; values stay below 2^394.
+CONV = 2 * NLIMBS - 1  # 73
+
+
+def _int_to_limbs(x: int, n: int = NLIMBS) -> np.ndarray:
+    """Canonical little-endian limb decomposition of a non-negative int."""
+    if x < 0:
+        raise ValueError("canonical limbs are non-negative")
+    out = np.zeros(n, dtype=np.int32)
+    for i in range(n):
+        out[i] = x & MASK
+        x >>= BITS
+    if x:
+        raise ValueError("value does not fit limb vector")
+    return out
+
+
+# -- precomputed constants ---------------------------------------------------
+
+# Gather/mask pair turning b (37 limbs) into the banded matrix Bmat[i, k] =
+# b[k-i], so that (a @ Bmat)[k] = Σ_i a_i·b_{k-i} — the full product.
+_K = np.arange(CONV)[None, :]  # (1, 73)
+_I = np.arange(NLIMBS)[:, None]  # (37, 1)
+_GATHER_IDX = np.clip(_K - _I, 0, NLIMBS - 1).astype(np.int32)  # (37, 73)
+_GATHER_MASK = ((_K - _I >= 0) & (_K - _I < NLIMBS)).astype(np.int32)
+
+# FOLD[j] = canonical limbs of (2^(11·(35+j)) mod Q), j = 0..37: replaces
+# limb positions ≥ 35 by their mod-Q equivalents.  Position 35 (2^385) is
+# already > Q, so folding from 35 keeps the value bound tight (< 2^394).
+_FOLD_ROWS = np.stack(
+    [_int_to_limbs(pow(1 << BITS, 35 + j, Q)) for j in range(NLIMBS + 1)]
+)  # (38, 37)
+
+Q_LIMBS = _int_to_limbs(Q)
+
+ZERO = np.zeros(NLIMBS, dtype=np.int32)
+ONE = _int_to_limbs(1)
+
+
+# -- host <-> device conversion ---------------------------------------------
+
+
+def from_int(x: int) -> np.ndarray:
+    """Canonical limb vector for x (reduced mod Q first)."""
+    return _int_to_limbs(x % Q)
+
+
+def from_ints(xs) -> np.ndarray:
+    """Stack of canonical limb vectors, shape (len(xs), NLIMBS)."""
+    return np.stack([from_int(int(x)) for x in xs])
+
+
+def to_int(limbs) -> int:
+    """Exact value of a (possibly lazy/negative) limb vector, mod Q."""
+    arr = np.asarray(limbs)
+    val = 0
+    for i in range(arr.shape[-1] - 1, -1, -1):
+        val = (val << BITS) + int(arr[..., i])
+    return val % Q
+
+
+def to_ints(batch) -> list:
+    arr = np.asarray(batch)
+    return [to_int(arr[i]) for i in range(arr.shape[0])]
+
+
+# -- core ops (all jnp, batch-agnostic over leading dims) --------------------
+
+
+def carry3(x: jnp.ndarray) -> jnp.ndarray:
+    """Three vectorized carry passes: limbs land in [-1, BASE+1].
+
+    Works for any |limb| ≤ 2^30.  The top limb accumulates incoming carries
+    without being split (its magnitude stays tiny because values are
+    < 2^394 ≪ 2^(11·36)), so nothing is ever truncated.
+    """
+    x = jnp.asarray(x)
+    for _ in range(3):
+        hi = x >> BITS  # arithmetic shift: correct floor for negatives
+        lo = x & MASK
+        # Keep the top limb whole.
+        lo = lo.at[..., -1].set(x[..., -1])
+        shifted = jnp.concatenate(
+            [jnp.zeros_like(hi[..., :1]), hi[..., :-1]], axis=-1
+        )
+        x = lo + shifted
+    return x
+
+
+def _fold(c: jnp.ndarray, rows: jnp.ndarray) -> jnp.ndarray:
+    """Replace limbs ≥ 35 via precomputed (2^(11·(35+j)) mod Q) rows."""
+    lo = c[..., :35]
+    hi = c[..., 35:]
+    lo = jnp.concatenate(
+        [lo, jnp.zeros(lo.shape[:-1] + (NLIMBS - 35,), dtype=lo.dtype)], axis=-1
+    )
+    return lo + jnp.einsum(
+        "...j,jk->...k", hi, rows[: hi.shape[-1]], preferred_element_type=jnp.int32
+    )
+
+
+_FOLD_J = jnp.asarray(_FOLD_ROWS)
+
+
+def reduce_conv(c: jnp.ndarray) -> jnp.ndarray:
+    """73-limb convolution output → 37-limb lazy residue."""
+    c = carry3(c)  # limbs ≤ BASE+1
+    c = _fold(c, _FOLD_J)  # 73 → 37 limbs, |value| < 2^398
+    c = carry3(c)
+    c = _fold(c, _FOLD_J)  # tidy limbs 35,36 → |value| < 2^394
+    return carry3(c)
+
+
+def add(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Lazy add — no carry (mul/carry3 downstream absorbs growth)."""
+    return a + b
+
+
+def sub(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Lazy subtract — limbs may go negative; that's fine."""
+    return a - b
+
+
+def neg(a: jnp.ndarray) -> jnp.ndarray:
+    return -a
+
+
+def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Full product + reduction.  Inputs may be lazy (|limb| ≤ 2^14ish from
+    a few chained adds); they are renormalized before the convolution."""
+    a = carry3(a)
+    b = carry3(b)
+    bmat = b[..., _GATHER_IDX] * _GATHER_MASK  # (..., 37, 73)
+    c = jnp.einsum(
+        "...i,...ik->...k", a, bmat, preferred_element_type=jnp.int32
+    )
+    return reduce_conv(c)
+
+
+def sqr(a: jnp.ndarray) -> jnp.ndarray:
+    return mul(a, a)
+
+
+def mul_n(pairs) -> list:
+    """Many independent Fq products as ONE stacked convolution.
+
+    XLA compile time scales with the number of dot_generals in a graph
+    (≈0.3 s each for this shape on CPU); a Miller-loop body written with
+    per-product `mul` calls takes minutes to compile.  Stacking n products
+    along a new leading axis costs one concat/slice pair and compiles —
+    and runs — as a single large batch multiply.  Operands must share a
+    broadcastable batch shape.
+    """
+    if len(pairs) == 1:
+        return [mul(pairs[0][0], pairs[0][1])]
+    common = ()
+    for a, b in pairs:
+        common = jnp.broadcast_shapes(common, jnp.shape(a), jnp.shape(b))
+    A = jnp.stack([jnp.broadcast_to(jnp.asarray(a), common) for a, _ in pairs])
+    B = jnp.stack([jnp.broadcast_to(jnp.asarray(b), common) for _, b in pairs])
+    C = mul(A, B)
+    return [C[i] for i in range(len(pairs))]
+
+
+def mul_small(a: jnp.ndarray, k: int) -> jnp.ndarray:
+    """Multiply by a small non-negative int (|k| < 2^15)."""
+    return reduce_small(a * jnp.int32(k))
+
+
+def reduce_small(x: jnp.ndarray) -> jnp.ndarray:
+    """Renormalize a 37-limb vector whose limbs grew (adds, small scalars)."""
+    x = carry3(x)
+    x = _fold(x, _FOLD_J)
+    return carry3(x)
+
+
+def select(cond: jnp.ndarray, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Branchless per-item select; cond shape broadcasts against (..., 37)."""
+    return jnp.where(cond[..., None], a, b)
+
+
+def pow_fixed(x: jnp.ndarray, exponent: int) -> jnp.ndarray:
+    """x^exponent for a Python-int exponent baked into the graph.
+
+    Uses a lax.scan over the fixed bit schedule (MSB first) so the graph
+    stays O(1) in exponent length: per step one square + one select-mul.
+    """
+    bits = [int(b) for b in bin(exponent)[2:]]
+    bits_arr = jnp.asarray(bits, dtype=jnp.int32)
+
+    def step(acc, bit):
+        acc = sqr(acc)
+        cond = jnp.broadcast_to(bit.astype(bool), acc.shape[:-1])
+        acc = select(cond, mul(acc, x), acc)
+        return acc, None
+
+    # Seed with 1 so the first iteration (MSB, always 1) sets acc = x.
+    ones = jnp.broadcast_to(jnp.asarray(ONE), x.shape)
+    acc, _ = jax.lax.scan(step, ones, bits_arr)
+    return acc
+
+
+def inv(x: jnp.ndarray) -> jnp.ndarray:
+    """Fermat inverse x^(Q-2).  ~760 muls — amortize with batch_inv."""
+    return pow_fixed(x, Q - 2)
+
+
+def is_zero_host(limbs) -> bool:
+    """Host-side exact zero test (the only canonical compare we ever need)."""
+    return to_int(limbs) == 0

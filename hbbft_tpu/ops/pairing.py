@@ -1,0 +1,320 @@
+"""Batched BLS12-381 optimal-ate pairing for TPU.
+
+The verification workhorse (SURVEY.md §3.2 "PAIRING CHECK — HOTTEST LOOP").
+Everything is batched over a leading axis and built from the golden-tested
+limb/tower/curve kernels — there are **no inversions and no data-dependent
+branches** in the Miller loop:
+
+* The G2 ladder point R runs in Jacobian coordinates via the already-tested
+  `curve.jac_double` / `curve.jac_add`.
+* Line functions are derived from R's Jacobian coordinates directly, each
+  scaled by a per-step Fq2 factor (2YZ³ for doubling, (x_Q·Z² − X)·Z for
+  addition).  Fq2 factors lie in a proper subfield killed by the final
+  exponentiation, so the pairing value is unchanged — the standard trick
+  that removes every division.
+* Untwist convention matches crypto/bls381.py: ψ(x', y') = (x'/w², y'/w³),
+  ξ = 1 + u, w⁶ = ξ.  A line through R' with twist-slope λ' evaluated at
+  P = (x_P, y_P) ∈ G1 becomes (after scaling by ξ):
+
+      l = ξ·y_P  +  (λ'·x' − y')·w³  −  λ'·x_P·w⁵
+
+  whose Fq12 coordinates are c0 = ((ξ·y_P), 0, 0), c1 = (0, λ'x'−y',
+  −λ'x_P) in the (v^i·w^j) basis — i.e. a sparse element.
+* The final exponentiation does the easy part structurally (conjugate,
+  one inverse, Frobenius²) and the hard part as a fixed-exponent scan
+  ((Q⁴−Q²+1)/R); a cyclotomic x-chain is a later optimization — the plain
+  chain is golden-testable directly against bls381.pairing.
+
+The product form `miller_product` multiplies several pairings' Miller
+values per item before one shared final exponentiation — this is what
+makes batched share verification cheap (e(a,b)==e(c,d) becomes
+FE(ML(a,b)·ML(−c,d)) == 1, two Miller loops and ONE final exp).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from hbbft_tpu.crypto.bls381 import BLS_X, BLS_X_IS_NEG
+from hbbft_tpu.crypto.field import Q, R as SUBGROUP_R
+from hbbft_tpu.ops import curve, fq, tower
+
+# Exponents for the final exponentiation.
+_EASY_DONE_HARD = (Q**4 - Q**2 + 1) // SUBGROUP_R
+
+# Miller bit schedule: MSB of |x| is implicit; iterate remaining bits.
+_X_BITS = [int(b) for b in bin(BLS_X)[3:]]
+
+
+# ---------------------------------------------------------------------------
+# Host <-> device affine points
+# ---------------------------------------------------------------------------
+
+
+def g1_affine_to_device(points: Sequence[Optional[Tuple[int, int]]]):
+    """Affine G1 ints (or None) → (x, y, inf) limb batch."""
+    xs = fq.from_ints([(p[0] if p else 0) for p in points])
+    ys = fq.from_ints([(p[1] if p else 1) for p in points])
+    inf = np.array([p is None for p in points])
+    return (jnp.asarray(xs), jnp.asarray(ys), jnp.asarray(inf))
+
+
+def g2_affine_to_device(points):
+    """Affine G2 tuples (or None) → (x fq2, y fq2, inf) batch."""
+    X = tower.fq2_stack([(p[0] if p else (0, 0)) for p in points])
+    Y = tower.fq2_stack([(p[1] if p else (1, 0)) for p in points])
+    inf = np.array([p is None for p in points])
+    return (
+        tuple(jnp.asarray(c) for c in X),
+        tuple(jnp.asarray(c) for c in Y),
+        jnp.asarray(inf),
+    )
+
+
+def g1_neg_device(P):
+    x, y, inf = P
+    return (x, fq.neg(y), inf)
+
+
+# ---------------------------------------------------------------------------
+# Line evaluations from Jacobian R
+# ---------------------------------------------------------------------------
+
+
+def _line_double(Rj, xP, yP):
+    """Line for the doubling step, scaled by 2YZ³ (Fq2 factor).
+
+    l = 2YZ³·ξ·y_P + (3X³ − 2Y²)·w³ − 3X²Z²·x_P·w⁵
+    Returns fq2 coefficients (c0a0, c1a1, c1a2).
+    """
+    X, Y, Z, _ = Rj
+    XX, YY, ZZ = tower.fq2_mul_many([(X, X), (Y, Y), (Z, Z)])
+    Z3, XXX, XXZZ = tower.fq2_mul_many([(ZZ, Z), (XX, X), (XX, ZZ)])
+    (YZ3,) = tower.fq2_mul_many([(Y, Z3)])
+    c1a1 = tower.fq2_sub(
+        tower.fq2_add(tower.fq2_add(XXX, XXX), XXX),
+        tower.fq2_add(YY, YY),
+    )
+    u = tower.fq2_mul_xi(tower.fq2_add(YZ3, YZ3))
+    v = tower.fq2_add(tower.fq2_add(XXZZ, XXZZ), XXZZ)
+    # The two Fq-scalar coefficient muls share one stacked multiply.
+    p = fq.mul_n([(u[0], yP), (u[1], yP), (v[0], xP), (v[1], xP)])
+    c0a0 = (p[0], p[1])
+    c1a2 = (fq.neg(p[2]), fq.neg(p[3]))
+    return (c0a0, c1a1, c1a2)
+
+
+def _line_add(Rj, Qa, xP, yP):
+    """Line for the mixed-addition step R + Q, scaled by D = (x_Q·Z² − X)·Z.
+
+    With N = y_Q·Z³ − Y (so twist slope λ' = N/D):
+      l = ξ·y_P·D + (N·x_Q − y_Q·D)·w³ − N·x_P·w⁵
+    """
+    X, Y, Z, _ = Rj
+    xQ, yQ, _ = Qa
+    (ZZ,) = tower.fq2_mul_many([(Z, Z)])
+    Z3, xQZZ = tower.fq2_mul_many([(ZZ, Z), (xQ, ZZ)])
+    yQZ3, D = tower.fq2_mul_many([(yQ, Z3), (tower.fq2_sub(xQZZ, X), Z)])
+    N = tower.fq2_sub(yQZ3, Y)
+    NxQ, yQD = tower.fq2_mul_many([(N, xQ), (yQ, D)])
+    c1a1 = tower.fq2_sub(NxQ, yQD)
+    u = tower.fq2_mul_xi(D)
+    p = fq.mul_n([(u[0], yP), (u[1], yP), (N[0], xP), (N[1], xP)])
+    c0a0 = (p[0], p[1])
+    c1a2 = (fq.neg(p[2]), fq.neg(p[3]))
+    return (c0a0, c1a1, c1a2)
+
+
+def _line_to_fq12(coeffs):
+    """(c0a0, c1a1, c1a2) sparse line → full fq12 element."""
+    c0a0, c1a1, c1a2 = coeffs
+    zero = tuple(jnp.zeros_like(jnp.asarray(c)) for c in c0a0)
+    c0 = (c0a0, zero, zero)
+    c1 = (zero, c1a1, c1a2)
+    return (c0, c1)
+
+
+# ---------------------------------------------------------------------------
+# Miller loop (batched over leading axis)
+# ---------------------------------------------------------------------------
+
+
+def miller_loop(P, Qa):
+    """f_{|x|,Q}(P), conjugated for x < 0 — batched.
+
+    P = (xP, yP, infP) limb batch; Qa = (xQ fq2, yQ fq2, infQ).
+    Items with an infinite P or Q yield f = 1.
+    """
+    xP, yP, infP = P
+    xQ, yQ, infQ = Qa
+    batch_shape = jnp.asarray(xP).shape[:-1]
+
+    one2 = tower.fq2_broadcast(tower.FQ2_ONE, batch_shape)
+    Rj0 = (xQ, yQ, one2, jnp.zeros(batch_shape, dtype=bool))
+    Qj = (xQ, yQ, one2, jnp.zeros(batch_shape, dtype=bool))
+
+    f0 = tower.fq12_broadcast_one(batch_shape)
+    bits = jnp.asarray(_X_BITS, dtype=jnp.int32)
+
+    def step(carry, bit):
+        f, Rj = carry
+        f = tower.fq12_sqr(f)
+        f = tower.fq12_mul(f, _line_to_fq12(_line_double(Rj, xP, yP)))
+        Rj = curve.jac_double(curve._F2, Rj)
+        # Addition path is computed unconditionally and selected — one scan
+        # body for all 63 iterations keeps the compiled graph small.
+        f_add = tower.fq12_mul(f, _line_to_fq12(_line_add(Rj, Qa, xP, yP)))
+        R_add = curve.jac_add(curve._F2, Rj, Qj)
+        cond = jnp.broadcast_to(bit.astype(bool), batch_shape)
+        f = tower.fq12_select(cond, f_add, f)
+        Rj = curve.jac_select(curve._F2, cond, R_add, Rj)
+        return (f, Rj), None
+
+    (f, _), _ = jax.lax.scan(step, (f0, Rj0), bits)
+
+    if BLS_X_IS_NEG:
+        f = tower.fq12_conj(f)
+
+    # Neutralize infinite inputs.
+    neutral = infP | infQ
+    return tower.fq12_select(neutral, tower.fq12_broadcast_one(batch_shape), f)
+
+
+def miller_product(pairs):
+    """Π_k ML(P_k, Q_k) per item — pairs is a list of (P, Qa) batches."""
+    f = None
+    for P, Qa in pairs:
+        fk = miller_loop(P, Qa)
+        f = fk if f is None else tower.fq12_mul(f, fk)
+    return f
+
+
+# ---------------------------------------------------------------------------
+# Final exponentiation
+# ---------------------------------------------------------------------------
+
+
+def final_exponentiation(f):
+    """f^((Q¹²−1)/R): structural easy part + fixed-scan hard part."""
+    # easy: f^((Q⁶−1)(Q²+1))
+    t0 = tower.fq12_conj(f)  # f^(Q⁶)
+    t1 = tower.fq12_inv(f)
+    t2 = tower.fq12_mul(t0, t1)  # f^(Q⁶−1)
+    t3 = tower.fq12_frobenius_n(t2, 2)
+    eased = tower.fq12_mul(t3, t2)  # ^(Q²+1)
+    # hard: ^((Q⁴−Q²+1)/R)
+    return tower.fq12_pow_fixed(eased, _EASY_DONE_HARD)
+
+
+def _cyclo_pow_x(m):
+    """m^x for the BLS parameter x (negative) — cyclotomic elements only,
+    where inverse = conjugate."""
+    p = tower.fq12_pow_fixed(m, BLS_X)
+    return tower.fq12_conj(p) if BLS_X_IS_NEG else p
+
+
+def final_exponentiation_fast(f):
+    """f^{3·(Q¹²−1)/R} — the x-power addition chain for the hard part.
+
+    Computes the THIRD POWER of the exact final exponentiation: the classic
+    BLS12 decomposition (verified exactly in tests against the integer
+    identity) is 3·(Q⁴−Q²+1)/R = c0 + c1·Q + c2·Q² + c3·Q³ with
+    c3 = (x−1)², c2 = c3·x, c1 = c2·x − c3, c0 = c1·x + 3.  Since
+    gcd(3, R) = 1 and f^H lies in the order-R subgroup, f^{3H} == 1 iff
+    f^H == 1 — so every verification check can use this chain (4 short
+    64-bit x-powers ≈ 5× cheaper than the plain 1270-bit scan).  Use
+    `final_exponentiation` when the exact pairing VALUE matters.
+    """
+    # easy part: f^((Q⁶−1)(Q²+1)) → cyclotomic subgroup
+    m = tower.fq12_mul(tower.fq12_conj(f), tower.fq12_inv(f))
+    m = tower.fq12_mul(tower.fq12_frobenius_n(m, 2), m)
+    # hard part ×3
+    a = _cyclo_pow_x(m)  # m^x
+    b = tower.fq12_mul(a, tower.fq12_conj(m))  # m^(x−1)
+    c = _cyclo_pow_x(b)  # m^(x²−x)
+    y3 = tower.fq12_mul(c, tower.fq12_conj(b))  # m^((x−1)²)
+    y2 = _cyclo_pow_x(y3)  # m^(c3·x)
+    y1 = tower.fq12_mul(_cyclo_pow_x(y2), tower.fq12_conj(y3))  # m^(c2·x−c3)
+    m3 = tower.fq12_mul(tower.fq12_sqr(m), m)
+    y0 = tower.fq12_mul(_cyclo_pow_x(y1), m3)  # m^(c1·x+3)
+    out = tower.fq12_mul(y0, tower.fq12_frobenius(y1))
+    out = tower.fq12_mul(out, tower.fq12_frobenius_n(y2, 2))
+    out = tower.fq12_mul(out, tower.fq12_frobenius_n(y3, 3))
+    return out
+
+
+def pairing(P, Qa):
+    """Full batched pairing e(P, Q) as fq12 limb elements."""
+    return final_exponentiation(miller_loop(P, Qa))
+
+
+def product2_fast(P1, Q1, P2, Q2):
+    """THE verification kernel: FE_fast(ML(P1,Q1)·ML(P2,Q2)) as fq12 limbs.
+
+    Single definition shared by the backend, the bench, the graft entry and
+    the mesh-sharded path, so they always measure/compile the same graph.
+    Host-compare each item against 1 (`is_one_host`) to decide
+    e(P1,Q1)·e(P2,Q2) == 1.
+    """
+    return final_exponentiation_fast(miller_product([(P1, Q1), (P2, Q2)]))
+
+
+def example_verify_batch(n_items: int, seed: int = 0, distinct: int = 8):
+    """Host-built batch of valid checks e(−G1, a·G2)·e(a·G1, G2) == 1.
+
+    Shared by bench.py and __graft_entry__ so the benchmark and the
+    driver's compile check exercise identical shapes.  `distinct` bounds
+    how many expensive golden scalar-muls are computed.
+    """
+    import random
+
+    from hbbft_tpu.crypto import bls381 as gold
+    from hbbft_tpu.crypto.field import R as SUBR
+
+    rng = random.Random(seed)
+    scalars = [rng.randrange(1, SUBR) for _ in range(max(1, distinct))]
+    pts = [
+        (
+            gold.ec_neg(gold.FQ, gold.G1_GEN),
+            gold.ec_mul(gold.FQ2, a, gold.G2_GEN),
+            gold.ec_mul(gold.FQ, a, gold.G1_GEN),
+            gold.G2_GEN,
+        )
+        for a in scalars
+    ]
+    quads = [pts[i % len(pts)] for i in range(n_items)]
+    return (
+        g1_affine_to_device([q[0] for q in quads]),
+        g2_affine_to_device([q[1] for q in quads]),
+        g1_affine_to_device([q[2] for q in quads]),
+        g2_affine_to_device([q[3] for q in quads]),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Host-side comparison (the only canonical reduction, at the seam)
+# ---------------------------------------------------------------------------
+
+
+def is_one_host(f, idx=None) -> bool:
+    """Exact check f == 1 in Fq12 (host ints)."""
+    from hbbft_tpu.crypto.bls381 import FQ12_ONE
+
+    return tower.fq12_to_ints(f, idx) == FQ12_ONE
+
+
+def product_check(pairs) -> np.ndarray:
+    """Per-item boolean: Π_k e(P_k, Q_k) == 1 (ONE shared final exp).
+
+    The canonical equality test runs host-side on the returned limbs —
+    the device graph stays scan/select-only.  Uses the fast (cubed)
+    final exponentiation: the == 1 outcome is identical (gcd(3, R) = 1).
+    """
+    f = final_exponentiation_fast(miller_product(pairs))
+    n = np.asarray(f[0][0][0]).shape[0]
+    return np.array([is_one_host(f, i) for i in range(n)])

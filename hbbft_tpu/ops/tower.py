@@ -1,0 +1,457 @@
+"""Batched BLS12-381 tower fields Fq2 / Fq6 / Fq12 on the limb kernel.
+
+Structure mirrors the pure-Python golden reference (crypto/bls381.py) —
+Fq2 = Fq[u]/(u²+1), Fq6 = Fq2[v]/(v³−ξ) with ξ = 1+u, Fq12 = Fq6[w]/(w²−v)
+— but every element coefficient is a (…, 37) int32 limb vector, so the same
+formulas run batched under jit/vmap/shard_map.
+
+Elements are pytrees of limb arrays:
+
+* fq2:  (c0, c1)
+* fq6:  (a0, a1, a2)      — fq2 coefficients
+* fq12: (b0, b1)          — fq6 coefficients
+
+Lazy-add discipline: adds/subs don't carry; `fq.mul` renormalizes its own
+inputs, so any formula with ≤ a few chained adds per mul operand is exact
+(see fq.py domain note).  Inversions go down the tower to a single Fq
+Fermat inverse; `batch_inv*` amortizes even that across a batch axis with
+the Montgomery product trick using parallel prefix/suffix scans.
+
+Frobenius constants are computed host-side with the golden-reference Fq2
+arithmetic at import time.
+
+Reference analogue: the `pairing` crate's Fq2/Fq6/Fq12 towers under
+`threshold_crypto` (SURVEY.md §2.2).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from hbbft_tpu.crypto import bls381 as gold
+from hbbft_tpu.crypto.field import Q
+from hbbft_tpu.ops import fq
+
+# ---------------------------------------------------------------------------
+# Fq2
+# ---------------------------------------------------------------------------
+
+FQ2_ZERO = (np.asarray(fq.ZERO), np.asarray(fq.ZERO))
+FQ2_ONE = (np.asarray(fq.ONE), np.asarray(fq.ZERO))
+
+
+def fq2_add(a, b):
+    return (fq.add(a[0], b[0]), fq.add(a[1], b[1]))
+
+
+def fq2_sub(a, b):
+    return (fq.sub(a[0], b[0]), fq.sub(a[1], b[1]))
+
+
+def fq2_neg(a):
+    return (fq.neg(a[0]), fq.neg(a[1]))
+
+
+def fq2_conj(a):
+    return (a[0], fq.neg(a[1]))
+
+
+def fq2_mul_pairs(a, b) -> list:
+    """The 3 Karatsuba Fq operand pairs of an fq2 product (for stacking)."""
+    return [
+        (a[0], b[0]),
+        (a[1], b[1]),
+        (fq.add(a[0], a[1]), fq.add(b[0], b[1])),
+    ]
+
+
+def fq2_from_products(t) -> tuple:
+    """Recombine the 3 Karatsuba products into (c0, c1)."""
+    return (fq.sub(t[0], t[1]), fq.sub(t[2], fq.add(t[0], t[1])))
+
+
+def fq2_mul(a, b):
+    return fq2_from_products(fq.mul_n(fq2_mul_pairs(a, b)))
+
+
+def fq2_mul_many(pairs) -> list:
+    """n independent fq2 products via ONE stacked Fq multiply (3n lanes)."""
+    flat = []
+    for a, b in pairs:
+        flat.extend(fq2_mul_pairs(a, b))
+    res = fq.mul_n(flat)
+    return [fq2_from_products(res[3 * i : 3 * i + 3]) for i in range(len(pairs))]
+
+
+def fq2_sqr(a):
+    # (a0+a1u)² = (a0+a1)(a0−a1) + 2a0a1·u — 2 Fq muls.
+    t0, t1 = fq.mul_n(
+        [(fq.add(a[0], a[1]), fq.sub(a[0], a[1])), (a[0], a[1])]
+    )
+    return (t0, fq.add(t1, t1))
+
+
+def fq2_mul_fq(a, k):
+    """Multiply by an Fq limb vector."""
+    return (fq.mul(a[0], k), fq.mul(a[1], k))
+
+
+def fq2_mul_small(a, k: int):
+    return (fq.mul_small(a[0], k), fq.mul_small(a[1], k))
+
+
+def fq2_mul_xi(a):
+    """Multiply by ξ = 1 + u:  (a0 − a1) + (a0 + a1)·u."""
+    return (fq.sub(a[0], a[1]), fq.add(a[0], a[1]))
+
+
+def fq2_inv(a):
+    n0, n1 = fq.mul_n([(a[0], a[0]), (a[1], a[1])])
+    ninv = fq.inv(fq.add(n0, n1))
+    m0, m1 = fq.mul_n([(a[0], ninv), (a[1], ninv)])
+    return (m0, fq.neg(m1))
+
+
+def fq2_select(cond, a, b):
+    return (fq.select(cond, a[0], b[0]), fq.select(cond, a[1], b[1]))
+
+
+def fq2_from_ints(pair) -> Tuple[np.ndarray, np.ndarray]:
+    return (fq.from_int(pair[0]), fq.from_int(pair[1]))
+
+
+def fq2_stack(pairs):
+    """Stack Python (c0, c1) int pairs into a batched fq2 element."""
+    return (
+        fq.from_ints([p[0] for p in pairs]),
+        fq.from_ints([p[1] for p in pairs]),
+    )
+
+
+def fq2_to_ints(a, idx=None) -> Tuple[int, int]:
+    c0, c1 = np.asarray(a[0]), np.asarray(a[1])
+    if idx is not None:
+        c0, c1 = c0[idx], c1[idx]
+    return (fq.to_int(c0), fq.to_int(c1))
+
+
+def fq2_broadcast(a, batch_shape):
+    return tuple(
+        jnp.broadcast_to(jnp.asarray(c), tuple(batch_shape) + (fq.NLIMBS,))
+        for c in a
+    )
+
+
+# ---------------------------------------------------------------------------
+# Fq6
+# ---------------------------------------------------------------------------
+
+FQ6_ZERO = (FQ2_ZERO, FQ2_ZERO, FQ2_ZERO)
+FQ6_ONE = (FQ2_ONE, FQ2_ZERO, FQ2_ZERO)
+
+
+def fq6_add(a, b):
+    return tuple(fq2_add(x, y) for x, y in zip(a, b))
+
+
+def fq6_sub(a, b):
+    return tuple(fq2_sub(x, y) for x, y in zip(a, b))
+
+
+def fq6_neg(a):
+    return tuple(fq2_neg(x) for x in a)
+
+
+def fq6_mul_fq2_pairs(a, b) -> list:
+    """The 6 fq2 operand pairs of a Toom/Karatsuba fq6 product."""
+    a0, a1, a2 = a
+    b0, b1, b2 = b
+    return [
+        (a0, b0),  # t0
+        (a1, b1),  # t1
+        (a2, b2),  # t2
+        (fq2_add(a1, a2), fq2_add(b1, b2)),  # m12
+        (fq2_add(a0, a1), fq2_add(b0, b1)),  # m01
+        (fq2_add(a0, a2), fq2_add(b0, b2)),  # m02
+    ]
+
+
+def fq6_from_products(res) -> tuple:
+    """Recombine [t0, t1, t2, m12, m01, m02] into (c0, c1, c2)."""
+    t0, t1, t2, m12, m01, m02 = res
+    c0 = fq2_add(t0, fq2_mul_xi(fq2_sub(m12, fq2_add(t1, t2))))
+    c1 = fq2_add(fq2_sub(m01, fq2_add(t0, t1)), fq2_mul_xi(t2))
+    c2 = fq2_add(fq2_sub(m02, fq2_add(t0, t2)), t1)
+    return (c0, c1, c2)
+
+
+def fq6_mul(a, b):
+    return fq6_from_products(fq2_mul_many(fq6_mul_fq2_pairs(a, b)))
+
+
+def fq6_sqr(a):
+    return fq6_mul(a, a)
+
+
+def fq6_mul_by_v(a):
+    return (fq2_mul_xi(a[2]), a[0], a[1])
+
+
+def fq6_mul_fq2(a, k):
+    return tuple(fq2_mul(x, k) for x in a)
+
+
+def fq6_inv(a):
+    a0, a1, a2 = a
+    s = fq2_mul_many(
+        [(a0, a0), (a1, a2), (a2, a2), (a0, a1), (a1, a1), (a0, a2)]
+    )
+    c0 = fq2_sub(s[0], fq2_mul_xi(s[1]))
+    c1 = fq2_sub(fq2_mul_xi(s[2]), s[3])
+    c2 = fq2_sub(s[4], s[5])
+    u = fq2_mul_many([(a2, c1), (a1, c2), (a0, c0)])
+    t = fq2_add(fq2_mul_xi(fq2_add(u[0], u[1])), u[2])
+    t_inv = fq2_inv(t)
+    out = fq2_mul_many([(c0, t_inv), (c1, t_inv), (c2, t_inv)])
+    return (out[0], out[1], out[2])
+
+
+def fq6_select(cond, a, b):
+    return tuple(fq2_select(cond, x, y) for x, y in zip(a, b))
+
+
+# ---------------------------------------------------------------------------
+# Fq12
+# ---------------------------------------------------------------------------
+
+FQ12_ZERO = (FQ6_ZERO, FQ6_ZERO)
+FQ12_ONE = (FQ6_ONE, FQ6_ZERO)
+
+
+def fq12_add(a, b):
+    return (fq6_add(a[0], b[0]), fq6_add(a[1], b[1]))
+
+
+def fq12_sub(a, b):
+    return (fq6_sub(a[0], b[0]), fq6_sub(a[1], b[1]))
+
+
+def fq12_mul(a, b):
+    # Karatsuba over Fq6; all 3 fq6 products (18 fq2, 54 Fq lanes) ride ONE
+    # stacked multiply.
+    a0, a1 = a
+    b0, b1 = b
+    flat = (
+        fq6_mul_fq2_pairs(a0, b0)
+        + fq6_mul_fq2_pairs(a1, b1)
+        + fq6_mul_fq2_pairs(fq6_add(a0, a1), fq6_add(b0, b1))
+    )
+    res = fq2_mul_many(flat)
+    t0 = fq6_from_products(res[0:6])
+    t1 = fq6_from_products(res[6:12])
+    mid = fq6_from_products(res[12:18])
+    c0 = fq6_add(t0, fq6_mul_by_v(t1))
+    c1 = fq6_sub(mid, fq6_add(t0, t1))
+    return (c0, c1)
+
+
+def fq12_sqr(a):
+    # Complex squaring over Fq6: both fq6 products in ONE stacked multiply.
+    a0, a1 = a
+    flat = fq6_mul_fq2_pairs(a0, a1) + fq6_mul_fq2_pairs(
+        fq6_add(a0, a1), fq6_add(a0, fq6_mul_by_v(a1))
+    )
+    res = fq2_mul_many(flat)
+    t = fq6_from_products(res[0:6])
+    u = fq6_from_products(res[6:12])
+    c0 = fq6_sub(u, fq6_add(t, fq6_mul_by_v(t)))
+    c1 = fq6_add(t, t)
+    return (c0, c1)
+
+
+def fq12_conj(a):
+    return (a[0], fq6_neg(a[1]))
+
+
+def fq12_inv(a):
+    a0, a1 = a
+    res = fq2_mul_many(
+        fq6_mul_fq2_pairs(a0, a0) + fq6_mul_fq2_pairs(a1, a1)
+    )
+    t = fq6_sub(
+        fq6_from_products(res[0:6]), fq6_mul_by_v(fq6_from_products(res[6:12]))
+    )
+    t_inv = fq6_inv(t)
+    res = fq2_mul_many(
+        fq6_mul_fq2_pairs(a0, t_inv) + fq6_mul_fq2_pairs(a1, t_inv)
+    )
+    return (
+        fq6_from_products(res[0:6]),
+        fq6_neg(fq6_from_products(res[6:12])),
+    )
+
+
+def fq12_select(cond, a, b):
+    return (fq6_select(cond, a[0], b[0]), fq6_select(cond, a[1], b[1]))
+
+
+def fq12_broadcast_one(batch_shape):
+    """Batched multiplicative identity."""
+    return jax.tree_util.tree_map(
+        lambda c: jnp.broadcast_to(
+            jnp.asarray(c), tuple(batch_shape) + (fq.NLIMBS,)
+        ),
+        FQ12_ONE,
+    )
+
+
+def fq12_pow_fixed(a, exponent: int):
+    """a^exponent for a fixed Python-int exponent, via lax.scan."""
+    if exponent == 0:
+        return fq12_broadcast_one(jnp.asarray(a[0][0][0]).shape[:-1])
+    bits = jnp.asarray([int(b) for b in bin(exponent)[2:]], dtype=jnp.int32)
+    batch_shape = jnp.asarray(a[0][0][0]).shape[:-1]
+
+    def step(acc, bit):
+        acc = fq12_sqr(acc)
+        cond = jnp.broadcast_to(bit.astype(bool), batch_shape)
+        acc = fq12_select(cond, fq12_mul(acc, a), acc)
+        return acc, None
+
+    acc, _ = jax.lax.scan(step, fq12_broadcast_one(batch_shape), bits)
+    return acc
+
+
+# ---------------------------------------------------------------------------
+# Frobenius x ↦ x^Q on Fq12.
+#
+# With w² = v, v³ = ξ:  frob(v) = v·ξ^((Q−1)/3),  frob(w) = w·ξ^((Q−1)/6),
+# and Fq2 coefficients are conjugated.  Writing a = Σ_{i<3,j<2} a_ij v^i w^j:
+#   frob(a) = Σ conj(a_ij) · C3^i · C6^j · v^i w^j
+# where C3 = ξ^((Q−1)/3), C6 = ξ^((Q−1)/6) ∈ Fq2 (host-precomputed below).
+# ---------------------------------------------------------------------------
+
+
+def _gold_fq2_pow(base, e: int):
+    acc = gold.FQ2_ONE
+    while e:
+        if e & 1:
+            acc = gold.fq2_mul(acc, base)
+        base = gold.fq2_sqr(base)
+        e >>= 1
+    return acc
+
+
+_XI = (1, 1)
+_C3_INT = _gold_fq2_pow(_XI, (Q - 1) // 3)
+_C6_INT = _gold_fq2_pow(_XI, (Q - 1) // 6)
+
+# FROB_COEFF[j][i] = C3^i · C6^j as canonical limb fq2 constants.
+_FROB_COEFF = [
+    [
+        fq2_from_ints(
+            gold.fq2_mul(_gold_fq2_pow(_C3_INT, i), _gold_fq2_pow(_C6_INT, j))
+        )
+        for i in range(3)
+    ]
+    for j in range(2)
+]
+
+
+def fq12_frobenius(a):
+    """x ↦ x^Q (one application) — 6 constant muls in one stack."""
+    pairs = [
+        (fq2_conj(a[j][i]), _FROB_COEFF[j][i])
+        for j in range(2)
+        for i in range(3)
+    ]
+    res = fq2_mul_many(pairs)
+    return ((res[0], res[1], res[2]), (res[3], res[4], res[5]))
+
+
+def fq12_frobenius_n(a, n: int):
+    for _ in range(n % 12):
+        a = fq12_frobenius(a)
+    return a
+
+
+# ---------------------------------------------------------------------------
+# Batch inversion (Montgomery trick) along a leading batch axis.
+# ---------------------------------------------------------------------------
+
+
+def _scan_products(x, mul_fn):
+    """Inclusive prefix and suffix products along axis 0."""
+    prefix = jax.lax.associative_scan(mul_fn, x, axis=0)
+    suffix = jax.lax.associative_scan(mul_fn, x, axis=0, reverse=True)
+    return prefix, suffix
+
+
+def batch_inv_fq2(x):
+    """Invert a batch of fq2 elements (leading axis) with ONE Fq inversion.
+
+    inv_i = prefix_{i−1} · suffix_{i+1} · (Π x)⁻¹ — prefix/suffix via
+    parallel scans, so the only sequential cost is the Fermat inverse of
+    the total product.  All elements must be nonzero.
+    """
+    prefix, suffix = _scan_products(x, fq2_mul)
+    total = jax.tree_util.tree_map(lambda c: c[-1], prefix)
+    tinv = fq2_inv(total)
+
+    p0, p1 = prefix
+    s0, s1 = suffix
+    one0 = jnp.broadcast_to(jnp.asarray(FQ2_ONE[0]), p0[:1].shape)
+    one1 = jnp.broadcast_to(jnp.asarray(FQ2_ONE[1]), p1[:1].shape)
+    pre = (  # prefix_{i-1}, with 1 at i = 0
+        jnp.concatenate([one0, p0[:-1]], axis=0),
+        jnp.concatenate([one1, p1[:-1]], axis=0),
+    )
+    suf = (  # suffix_{i+1}, with 1 at i = n-1
+        jnp.concatenate([s0[1:], one0], axis=0),
+        jnp.concatenate([s1[1:], one1], axis=0),
+    )
+    wing = fq2_mul(pre, suf)
+    return fq2_mul(wing, fq2_broadcast_like(tinv, x))
+
+
+def fq2_broadcast_like(a, ref):
+    shape = jnp.asarray(ref[0]).shape
+    return tuple(jnp.broadcast_to(jnp.asarray(c), shape) for c in a)
+
+
+# ---------------------------------------------------------------------------
+# Host conversion fq6 / fq12 <-> golden tuples
+# ---------------------------------------------------------------------------
+
+
+def fq6_from_ints(t):
+    return tuple(fq2_from_ints(x) for x in t)
+
+
+def fq12_from_ints(t):
+    return tuple(fq6_from_ints(x) for x in t)
+
+
+def fq6_stack(ts):
+    return tuple(
+        fq2_stack([t[i] for t in ts]) for i in range(3)
+    )
+
+
+def fq12_stack(ts):
+    return tuple(
+        fq6_stack([t[i] for t in ts]) for i in range(2)
+    )
+
+
+def fq6_to_ints(a, idx=None):
+    return tuple(fq2_to_ints(x, idx) for x in a)
+
+
+def fq12_to_ints(a, idx=None):
+    return tuple(fq6_to_ints(x, idx) for x in a)

@@ -1,0 +1,15 @@
+from hbbft_tpu.parallel.mesh import (
+    BATCH_AXIS,
+    device_mesh,
+    shard_batch,
+    sharded_combine_g2_fn,
+    sharded_product2_fn,
+)
+
+__all__ = [
+    "BATCH_AXIS",
+    "device_mesh",
+    "shard_batch",
+    "sharded_combine_g2_fn",
+    "sharded_product2_fn",
+]

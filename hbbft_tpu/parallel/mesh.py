@@ -1,0 +1,88 @@
+"""Multi-chip sharding of the crypto batch dimension.
+
+BASELINE.json config 5: at N=256 the per-epoch share-verification batch is
+sharded over the chips of a v5e-8 slice; per-item pairing work is purely
+data-parallel (rides each chip's VPU/MXU), while share *combination*
+all-gathers partial Jacobian sums over ICI.
+
+The batch axis is the (epoch × node × instance × share) work-item axis from
+SURVEY.md §2.3 — the only scaling axis this framework has, playing the role
+DP/TP/SP play in an ML stack.
+
+Everything here works identically on a real multi-chip slice and on the
+virtual 8-device CPU mesh used in CI (tests/conftest.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from hbbft_tpu.ops import curve, pairing
+
+BATCH_AXIS = "batch"
+
+
+def device_mesh(n_devices: Optional[int] = None) -> Mesh:
+    """1-D mesh over the first n (default: all) local devices."""
+    devs = jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    return Mesh(np.array(devs), axis_names=(BATCH_AXIS,))
+
+
+def _batch_sharding(mesh: Mesh, leaf: jnp.ndarray) -> NamedSharding:
+    """Shard the leading (batch) axis, replicate the rest."""
+    spec = P(BATCH_AXIS, *([None] * (leaf.ndim - 1)))
+    return NamedSharding(mesh, spec)
+
+
+def shard_batch(tree: Any, mesh: Mesh) -> Any:
+    """device_put every leaf with its leading axis split over the mesh."""
+    return jax.tree_util.tree_map(
+        lambda leaf: jax.device_put(
+            jnp.asarray(leaf), _batch_sharding(mesh, jnp.asarray(leaf))
+        ),
+        tree,
+    )
+
+
+def sharded_product2_fn(mesh: Mesh):
+    """Jitted sharded (P1,Q1,P2,Q2) → fq12 limbs of FE(ML·ML).
+
+    Data-parallel over the mesh: XLA partitions the whole pairing graph on
+    the batch axis; no cross-chip traffic until the host gathers results.
+    """
+
+    def wrapped(P1, Q1, P2, Q2):
+        args = jax.tree_util.tree_map(
+            lambda leaf: jax.lax.with_sharding_constraint(
+                leaf, _batch_sharding(mesh, leaf)
+            ),
+            (P1, Q1, P2, Q2),
+        )
+        return pairing.product2_fast(*args)
+
+    return jax.jit(wrapped)
+
+
+def sharded_combine_g2_fn(mesh: Mesh):
+    """Jitted sharded Lagrange combine: shares sharded over chips, partial
+    Jacobian sums reduced across the mesh (XLA inserts the ICI collective
+    for the cross-shard tree-add)."""
+
+    def f(points, bits, negs):
+        points = jax.tree_util.tree_map(
+            lambda leaf: jax.lax.with_sharding_constraint(
+                leaf, _batch_sharding(mesh, leaf)
+            ),
+            points,
+        )
+        return curve.linear_combine_g2(points, bits, negs)
+
+    return jax.jit(f)

@@ -1,0 +1,25 @@
+"""Shared JAX configuration helpers."""
+
+from __future__ import annotations
+
+import os
+
+
+def enable_compile_cache(cache_dir: str | None = None) -> None:
+    """Point JAX's persistent compilation cache at the repo-local dir.
+
+    The pairing graphs take tens of seconds (CPU: minutes pre-stacking) to
+    compile; the cache makes every subsequent process — tests, bench, the
+    driver's graft checks — reuse compiled modules.  Safe to call multiple
+    times or before/after other jax.config updates.
+    """
+    import jax
+
+    if cache_dir is None:
+        repo = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+        cache_dir = os.path.join(repo, ".jax_cache")
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass  # older jax without the knobs — cache is an optimization only

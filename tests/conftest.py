@@ -24,7 +24,14 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+# Persistent compilation cache: the pairing graphs are compile-heavy on
+# CPU; cache across test runs and rounds.
+from hbbft_tpu.utils.jax_config import enable_compile_cache  # noqa: E402
+
+enable_compile_cache()
 
 
 def pytest_configure(config):

@@ -1,0 +1,146 @@
+"""Golden tests: JAX Jacobian G1/G2 ops vs the pure-Python bls381 reference."""
+
+import random
+
+import numpy as np
+import pytest
+
+from hbbft_tpu.crypto import bls381 as gold
+from hbbft_tpu.crypto.field import R
+from hbbft_tpu.ops import curve
+
+
+@pytest.fixture(scope="module")
+def rng():
+    return random.Random(7)
+
+
+def rnd_g1(rng):
+    return gold.ec_mul(gold.FQ, rng.randrange(1, R), gold.G1_GEN)
+
+
+def rnd_g2(rng):
+    return gold.ec_mul(gold.FQ2, rng.randrange(1, R), gold.G2_GEN)
+
+
+def test_g1_roundtrip(rng):
+    pts = [rnd_g1(rng) for _ in range(4)] + [None]
+    dev = curve.g1_to_device(pts)
+    assert curve.g1_from_device(dev) == pts
+
+
+def test_g2_roundtrip(rng):
+    pts = [rnd_g2(rng) for _ in range(3)] + [None]
+    dev = curve.g2_to_device(pts)
+    assert curve.g2_from_device(dev) == pts
+
+
+def test_g1_double_add(rng):
+    pts = [rnd_g1(rng) for _ in range(6)]
+    others = [rnd_g1(rng) for _ in range(6)]
+    P = curve.g1_to_device(pts)
+    Qp = curve.g1_to_device(others)
+    got_d = curve.g1_from_device(curve.jac_double(curve._F1, P))
+    assert got_d == [gold.ec_double(gold.FQ, p) for p in pts]
+    got_a = curve.g1_from_device(curve.jac_add(curve._F1, P, Qp))
+    assert got_a == [gold.ec_add(gold.FQ, p, q) for p, q in zip(pts, others)]
+
+
+def test_g1_add_infinity(rng):
+    p = rnd_g1(rng)
+    P = curve.g1_to_device([p, None, None])
+    Qp = curve.g1_to_device([None, p, None])
+    got = curve.g1_from_device(curve.jac_add(curve._F1, P, Qp))
+    assert got == [p, p, None]
+
+
+def test_g2_double_add(rng):
+    pts = [rnd_g2(rng) for _ in range(4)]
+    others = [rnd_g2(rng) for _ in range(4)]
+    P = curve.g2_to_device(pts)
+    Qp = curve.g2_to_device(others)
+    got_d = curve.g2_from_device(curve.jac_double(curve._F2, P))
+    assert got_d == [gold.ec_double(gold.FQ2, p) for p in pts]
+    got_a = curve.g2_from_device(curve.jac_add(curve._F2, P, Qp))
+    assert got_a == [gold.ec_add(gold.FQ2, p, q) for p, q in zip(pts, others)]
+
+
+def test_safe_scalar(rng):
+    for s in [0, 1, 2, R - 1, R - 2, (R - 1) // 2, (R + 1) // 2] + [
+        rng.randrange(R) for _ in range(50)
+    ]:
+        s2, negate = curve.safe_scalar(s)
+        assert s2 < (1 << curve.SCALAR_BITS)
+        assert (R - s2 if negate else s2) % R == s % R
+
+
+def test_g1_scalar_mul(rng):
+    pts = [rnd_g1(rng) for _ in range(4)]
+    raw = [rng.randrange(R) for _ in range(3)] + [1]
+    safe = [curve.safe_scalar(s) for s in raw]
+    bits = curve.scalars_to_bits([s for s, _ in safe])
+    P = curve.g1_to_device(pts)
+    prod = curve.g1_scalar_mul_batch(P, bits)
+    prod = curve.jac_select(
+        curve._F1,
+        np.array([neg for _, neg in safe]),
+        curve.jac_neg(curve._F1, prod),
+        prod,
+    )
+    got = curve.g1_from_device(prod)
+    assert got == [gold.ec_mul(gold.FQ, s, p) for s, p in zip(raw, pts)]
+
+
+def test_g2_scalar_mul(rng):
+    pts = [rnd_g2(rng) for _ in range(2)]
+    raw = [rng.randrange(R) for _ in range(2)]
+    safe = [curve.safe_scalar(s) for s in raw]
+    bits = curve.scalars_to_bits([s for s, _ in safe])
+    P = curve.g2_to_device(pts)
+    prod = curve.g2_scalar_mul_batch(P, bits)
+    prod = curve.jac_select(
+        curve._F2,
+        np.array([neg for _, neg in safe]),
+        curve.jac_neg(curve._F2, prod),
+        prod,
+    )
+    got = curve.g2_from_device(prod)
+    assert got == [gold.ec_mul(gold.FQ2, s, p) for s, p in zip(raw, pts)]
+
+
+def test_linear_combine_g1_matches_lagrange(rng):
+    """Σ λ_i·P_i on device == golden g1_lagrange_combine."""
+    group = gold.BLS381Group()
+    secret = rng.randrange(R)
+    from hbbft_tpu.crypto.field import lagrange_coeffs_at_zero
+
+    # Shamir-style: P_i = f(i+1)·G, reconstruct f(0)·G.
+    coeffs = [secret] + [rng.randrange(R) for _ in range(2)]
+
+    def f(x):
+        return sum(c * x**k for k, c in enumerate(coeffs)) % R
+
+    xs = [1, 2, 4, 5]
+    pts = [gold.ec_mul(gold.FQ, f(x), gold.G1_GEN) for x in xs]
+    lam = lagrange_coeffs_at_zero(xs)
+    safe = [curve.safe_scalar(l) for l in lam]
+    bits = curve.scalars_to_bits([s for s, _ in safe])
+    negs = np.array([n for _, n in safe])
+    combined = curve.linear_combine_g1(curve.g1_to_device(pts), bits, negs)
+    got = curve.g1_from_device(combined)[0]
+    want = gold.ec_mul(gold.FQ, secret, gold.G1_GEN)
+    assert got == want
+
+
+def test_linear_combine_g2(rng):
+    pts = [rnd_g2(rng) for _ in range(3)]
+    lam = [rng.randrange(R) for _ in range(3)]
+    safe = [curve.safe_scalar(l) for l in lam]
+    bits = curve.scalars_to_bits([s for s, _ in safe])
+    negs = np.array([n for _, n in safe])
+    combined = curve.linear_combine_g2(curve.g2_to_device(pts), bits, negs)
+    got = curve.g2_from_device(combined)[0]
+    want = None
+    for l, p in zip(lam, pts):
+        want = gold.ec_add(gold.FQ2, want, gold.ec_mul(gold.FQ2, l, p))
+    assert got == want

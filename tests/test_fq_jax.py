@@ -1,0 +1,123 @@
+"""Golden tests: JAX limb Fq arithmetic vs Python-int arithmetic mod Q.
+
+Every device field op is checked against exact big-int math, including
+adversarial limb patterns (all-max, negatives from deep subtraction chains)
+— SURVEY.md §7 hard part 1 prescribes golden-testing every layer from the
+first commit.
+"""
+
+import numpy as np
+import pytest
+
+from hbbft_tpu.crypto.field import Q
+from hbbft_tpu.ops import fq
+
+
+def rnd_ints(rng, n):
+    return [rng.randrange(Q) for _ in range(n)]
+
+
+@pytest.fixture(scope="module")
+def rng():
+    import random
+
+    return random.Random(1234)
+
+
+def test_roundtrip(rng):
+    for x in [0, 1, Q - 1, Q // 2] + rnd_ints(rng, 20):
+        assert fq.to_int(fq.from_int(x)) == x % Q
+
+
+def test_add_sub_neg(rng):
+    xs = rnd_ints(rng, 32)
+    ys = rnd_ints(rng, 32)
+    a = fq.from_ints(xs)
+    b = fq.from_ints(ys)
+    assert fq.to_ints(np.asarray(fq.add(a, b))) == [(x + y) % Q for x, y in zip(xs, ys)]
+    assert fq.to_ints(np.asarray(fq.sub(a, b))) == [(x - y) % Q for x, y in zip(xs, ys)]
+    assert fq.to_ints(np.asarray(fq.neg(a))) == [(-x) % Q for x in xs]
+
+
+def test_mul_batch(rng):
+    xs = rnd_ints(rng, 64) + [0, 1, Q - 1, Q - 1]
+    ys = rnd_ints(rng, 64) + [Q - 1, Q - 1, Q - 1, 0]
+    a = fq.from_ints(xs)
+    b = fq.from_ints(ys)
+    got = fq.to_ints(np.asarray(fq.mul(a, b)))
+    assert got == [(x * y) % Q for x, y in zip(xs, ys)]
+
+
+def test_mul_lazy_inputs(rng):
+    """Products of un-carried sums/differences must still be exact."""
+    xs, ys, zs = (rnd_ints(rng, 16) for _ in range(3))
+    a, b, c = fq.from_ints(xs), fq.from_ints(ys), fq.from_ints(zs)
+    lazy1 = fq.add(fq.add(a, b), c)  # limbs up to ~3·2^11
+    lazy2 = fq.sub(fq.sub(a, b), c)  # negative limbs
+    got = fq.to_ints(np.asarray(fq.mul(lazy1, lazy2)))
+    want = [
+        ((x + y + z) * (x - y - z)) % Q for x, y, z in zip(xs, ys, zs)
+    ]
+    assert got == want
+
+
+def test_mul_worst_case_limbs():
+    """Worst in-domain lazy limbs (|value| < 2^395) stay exact through mul.
+
+    All-max limbs in positions 0..34 put the value right at the fold
+    boundary; the negated variant exercises the signed path.
+    """
+    worst = np.zeros((4, fq.NLIMBS), dtype=np.int32)
+    worst[:2, :35] = fq.MASK
+    worst[2:, :35] = -fq.MASK
+    vals = [fq.to_int(w) for w in worst]
+    got = fq.to_ints(np.asarray(fq.mul(worst, worst[::-1].copy())))
+    assert got == [(a * b) % Q for a, b in zip(vals, vals[::-1])]
+
+
+def test_value_bound_invariant(rng):
+    """Lazy residues stay within limb bounds through long op chains."""
+    xs = rnd_ints(rng, 8)
+    a = fq.from_ints(xs)
+    acc = a
+    for _ in range(12):
+        acc = fq.mul(fq.add(acc, a), fq.sub(acc, a))
+    arr = np.asarray(acc)
+    assert np.all(np.abs(arr) <= fq.BASE + 1)
+    # exactness after the chain
+    vals = xs[:]
+    accv = xs[:]
+    for _ in range(12):
+        accv = [((v + x) * (v - x)) % Q for v, x in zip(accv, vals)]
+    assert fq.to_ints(arr) == accv
+
+
+def test_mul_small(rng):
+    xs = rnd_ints(rng, 16)
+    a = fq.from_ints(xs)
+    for k in (0, 1, 2, 3, 4, 12, 32767):
+        got = fq.to_ints(np.asarray(fq.mul_small(a, k)))
+        assert got == [(x * k) % Q for x in xs]
+
+
+def test_pow_and_inv(rng):
+    xs = rnd_ints(rng, 4)
+    a = fq.from_ints(xs)
+    got = fq.to_ints(np.asarray(fq.pow_fixed(a, 65537)))
+    assert got == [pow(x, 65537, Q) for x in xs]
+    inv = fq.to_ints(np.asarray(fq.inv(a)))
+    assert inv == [pow(x, -1, Q) for x in xs]
+
+
+def test_jit_and_vmap(rng):
+    import jax
+    import jax.numpy as jnp
+
+    xs = rnd_ints(rng, 8)
+    ys = rnd_ints(rng, 8)
+    a = jnp.asarray(fq.from_ints(xs))
+    b = jnp.asarray(fq.from_ints(ys))
+    f = jax.jit(fq.mul)
+    assert fq.to_ints(np.asarray(f(a, b))) == [(x * y) % Q for x, y in zip(xs, ys)]
+    g = jax.jit(jax.vmap(fq.mul))
+    assert fq.to_ints(np.asarray(g(a, b))) == [(x * y) % Q for x, y in zip(xs, ys)]

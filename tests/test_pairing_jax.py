@@ -1,0 +1,120 @@
+"""Golden tests: batched JAX pairing vs the pure-Python bls381 reference.
+
+The pure-Python pairing takes seconds per evaluation, so the suite uses a
+small number of carefully chosen cases: exact value match, bilinearity
+through the device path, the product-check identity used by verification,
+and infinity handling.
+"""
+
+import random
+
+import pytest
+
+from hbbft_tpu.crypto import bls381 as gold
+from hbbft_tpu.crypto.field import R
+from hbbft_tpu.ops import pairing, tower
+
+
+@pytest.fixture(scope="module")
+def rng():
+    return random.Random(42)
+
+
+def test_pairing_matches_golden(rng):
+    a = rng.randrange(1, R)
+    b = rng.randrange(1, R)
+    P1 = gold.G1_GEN
+    P2 = gold.ec_mul(gold.FQ, a, gold.G1_GEN)
+    Q1 = gold.G2_GEN
+    Q2 = gold.ec_mul(gold.FQ2, b, gold.G2_GEN)
+
+    Pd = pairing.g1_affine_to_device([P1, P2])
+    Qd = pairing.g2_affine_to_device([Q1, Q2])
+    f = pairing.pairing(Pd, Qd)
+
+    assert tower.fq12_to_ints(f, 0) == gold.pairing(P1, Q1)
+    assert tower.fq12_to_ints(f, 1) == gold.pairing(P2, Q2)
+
+
+def test_bilinearity_product_check(rng):
+    # e(aP, Q) · e(-P, aQ) == 1
+    a = rng.randrange(1, R)
+    aP = gold.ec_mul(gold.FQ, a, gold.G1_GEN)
+    aQ = gold.ec_mul(gold.FQ2, a, gold.G2_GEN)
+    negP = gold.ec_neg(gold.FQ, gold.G1_GEN)
+
+    # and a deliberately broken second item
+    b = (a + 1) % R
+    bQ = gold.ec_mul(gold.FQ2, b, gold.G2_GEN)
+
+    pairs = [
+        (
+            pairing.g1_affine_to_device([aP, aP]),
+            pairing.g2_affine_to_device([gold.G2_GEN, gold.G2_GEN]),
+        ),
+        (
+            pairing.g1_affine_to_device([negP, negP]),
+            pairing.g2_affine_to_device([aQ, bQ]),
+        ),
+    ]
+    ok = pairing.product_check(pairs)
+    assert list(ok) == [True, False]
+
+
+def test_pairing_infinity(rng):
+    Pd = pairing.g1_affine_to_device([None, gold.G1_GEN])
+    Qd = pairing.g2_affine_to_device([gold.G2_GEN, None])
+    f = pairing.pairing(Pd, Qd)
+    assert pairing.is_one_host(f, 0)
+    assert pairing.is_one_host(f, 1)
+
+
+def test_fast_final_exp_decomposition_identity():
+    """Integer identity behind final_exponentiation_fast (exact check)."""
+    from hbbft_tpu.crypto.bls381 import BLS_X
+    from hbbft_tpu.crypto.field import Q, R as SUBR
+
+    x = -BLS_X  # the BLS parameter is negative
+    H = (Q**4 - Q**2 + 1) // SUBR
+    c3 = (x - 1) ** 2
+    c2 = c3 * x
+    c1 = c2 * x - c3
+    c0 = c1 * x + 3
+    assert c0 + c1 * Q + c2 * Q**2 + c3 * Q**3 == 3 * H
+    assert SUBR % 3 != 0  # gcd(3, R) = 1 → f^{3H}==1 ⟺ f^H==1
+
+
+def test_fast_final_exp_is_cube(rng):
+    """FE_fast(f) == FE(f)³ on a real Miller output."""
+    a = rng.randrange(1, R)
+    P = pairing.g1_affine_to_device([gold.ec_mul(gold.FQ, a, gold.G1_GEN)])
+    Qd = pairing.g2_affine_to_device([gold.G2_GEN])
+    ml = pairing.miller_loop(P, Qd)
+    exact = tower.fq12_to_ints(pairing.final_exponentiation(ml), 0)
+    fast = tower.fq12_to_ints(pairing.final_exponentiation_fast(ml), 0)
+    cube = gold.fq12_mul(gold.fq12_mul(exact, exact), exact)
+    assert fast == cube
+
+
+def test_miller_product_matches_separate(rng):
+    """FE(ML(P,Q)·ML(P',Q')) == e(P,Q)·e(P',Q') (golden side)."""
+    a = rng.randrange(1, R)
+    b = rng.randrange(1, R)
+    P = gold.ec_mul(gold.FQ, a, gold.G1_GEN)
+    Qq = gold.ec_mul(gold.FQ2, b, gold.G2_GEN)
+
+    pairs = [
+        (
+            pairing.g1_affine_to_device([P]),
+            pairing.g2_affine_to_device([gold.G2_GEN]),
+        ),
+        (
+            pairing.g1_affine_to_device([gold.G1_GEN]),
+            pairing.g2_affine_to_device([Qq]),
+        ),
+    ]
+    f = pairing.final_exponentiation(pairing.miller_product(pairs))
+    want = gold.fq12_mul(
+        gold.pairing(P, gold.G2_GEN), gold.pairing(gold.G1_GEN, Qq)
+    )
+    assert tower.fq12_to_ints(f, 0) == want

@@ -1,0 +1,113 @@
+"""Property-based tests — the reference's proptest strategy, in hypothesis.
+
+Mirrors `tests/net/proptest.rs` § (SURVEY.md §4): a `NetworkDimension`-style
+strategy samples valid (N, f) pairs with f < N/3, runs protocol nets under
+randomly drawn adversaries and seeds, and asserts the consensus invariants.
+Hypothesis shrinks failures to minimal dimensions, like proptest.
+"""
+
+from hypothesis import HealthCheck, given, settings, strategies as st
+
+from hbbft_tpu.crypto.backend import MockBackend
+from hbbft_tpu.net.adversary import (
+    NodeOrderAdversary,
+    NullAdversary,
+    ReorderingAdversary,
+    SilentAdversary,
+)
+from hbbft_tpu.net.virtual_net import NetBuilder
+from hbbft_tpu.protocols.binary_agreement import BinaryAgreement
+from hbbft_tpu.protocols.broadcast import Broadcast
+from hbbft_tpu.protocols.threshold_sign import ThresholdSign
+
+
+@st.composite
+def network_dimension(draw, max_nodes=10):
+    """Valid (n, f): 1 ≤ n ≤ max_nodes, f < n/3 (NetworkDimension §)."""
+    n = draw(st.integers(min_value=1, max_value=max_nodes))
+    max_f = max(0, (n - 1) // 3)
+    f = draw(st.integers(min_value=0, max_value=max_f))
+    return (n, f)
+
+
+adversaries = st.sampled_from(
+    [NullAdversary, ReorderingAdversary, NodeOrderAdversary]
+)
+
+_settings = settings(
+    max_examples=20,
+    deadline=None,
+    suppress_health_check=[HealthCheck.too_slow],
+)
+
+
+@given(dim=network_dimension(), adv=adversaries, seed=st.integers(0, 2**16))
+@_settings
+def test_threshold_sign_agreement(dim, adv, seed):
+    n, f = dim
+    net = (
+        NetBuilder(range(n))
+        .num_faulty(f)
+        .adversary(adv())
+        .defer_mode("round")
+        .using(lambda ni, be: ThresholdSign(ni, be, doc=b"prop"))
+        .build(seed=seed)
+    )
+    net.broadcast_input(None)
+    net.crank_to_quiescence()
+    outs = [node.outputs for node in net.correct_nodes()]
+    assert all(len(o) == 1 for o in outs)
+    assert all(o == outs[0] for o in outs)
+
+
+@given(
+    dim=network_dimension(max_nodes=8),
+    adv=adversaries,
+    seed=st.integers(0, 2**16),
+    value=st.binary(min_size=1, max_size=64),
+)
+@_settings
+def test_broadcast_agreement(dim, adv, seed, value):
+    n, f = dim
+    net = (
+        NetBuilder(range(n))
+        .num_faulty(f)
+        .adversary(adv())
+        .using(lambda ni, be: Broadcast(ni, proposer_id=0))
+        .build(seed=seed)
+    )
+    # Only deliver the proposal if the proposer is correct; a faulty
+    # proposer may equivocate, in which case all-or-nothing must hold.
+    if not net.nodes[0].faulty:
+        net.send_input(0, value)
+        net.crank_to_quiescence()
+        outs = [node.outputs for node in net.correct_nodes()]
+        assert all(o == [value] for o in outs)
+    else:
+        net.crank_to_quiescence()
+
+
+@given(
+    dim=network_dimension(max_nodes=7),
+    seed=st.integers(0, 2**16),
+    proposals=st.lists(st.booleans(), min_size=7, max_size=7),
+)
+@_settings
+def test_binary_agreement_decides_same(dim, seed, proposals):
+    n, f = dim
+    net = (
+        NetBuilder(range(n))
+        .num_faulty(f)
+        .defer_mode("round")
+        .using(lambda ni, be: BinaryAgreement(ni, be, session_id=b"prop-ba"))
+        .build(seed=seed)
+    )
+    for i in range(n):
+        net.send_input(i, proposals[i % len(proposals)])
+    net.crank_to_quiescence()
+    outs = [node.outputs for node in net.correct_nodes()]
+    assert all(len(o) == 1 for o in outs)
+    decided = {o[0] for o in outs}
+    assert len(decided) == 1
+    # Validity: the decision must be someone's proposal.
+    assert decided.pop() in set(proposals[:n])

@@ -1,0 +1,114 @@
+"""Golden tests: JAX tower fields vs the pure-Python bls381 reference."""
+
+import random
+
+import numpy as np
+import pytest
+
+from hbbft_tpu.crypto import bls381 as gold
+from hbbft_tpu.crypto.field import Q
+from hbbft_tpu.ops import tower
+
+
+@pytest.fixture(scope="module")
+def rng():
+    return random.Random(99)
+
+
+def rnd_fq2(rng):
+    return (rng.randrange(Q), rng.randrange(Q))
+
+
+def rnd_fq6(rng):
+    return tuple(rnd_fq2(rng) for _ in range(3))
+
+
+def rnd_fq12(rng):
+    return tuple(rnd_fq6(rng) for _ in range(2))
+
+
+def test_fq2_ops(rng):
+    xs = [rnd_fq2(rng) for _ in range(16)]
+    ys = [rnd_fq2(rng) for _ in range(16)]
+    a = tower.fq2_stack(xs)
+    b = tower.fq2_stack(ys)
+
+    got = tower.fq2_mul(a, b)
+    for i in range(16):
+        assert tower.fq2_to_ints(got, i) == gold.fq2_mul(xs[i], ys[i])
+
+    got = tower.fq2_sqr(a)
+    for i in range(16):
+        assert tower.fq2_to_ints(got, i) == gold.fq2_sqr(xs[i])
+
+    got = tower.fq2_mul_xi(a)
+    for i in range(16):
+        assert tower.fq2_to_ints(got, i) == gold.fq2_mul_xi(xs[i])
+
+    got = tower.fq2_inv(a)
+    for i in range(16):
+        assert tower.fq2_to_ints(got, i) == gold.fq2_inv(xs[i])
+
+
+def test_fq6_ops(rng):
+    xs = [rnd_fq6(rng) for _ in range(8)]
+    ys = [rnd_fq6(rng) for _ in range(8)]
+    a = tower.fq6_stack(xs)
+    b = tower.fq6_stack(ys)
+
+    got = tower.fq6_mul(a, b)
+    for i in range(8):
+        assert tower.fq6_to_ints(got, i) == gold.fq6_mul(xs[i], ys[i])
+
+    got = tower.fq6_mul_by_v(a)
+    for i in range(8):
+        assert tower.fq6_to_ints(got, i) == gold.fq6_mul_by_v(xs[i])
+
+    got = tower.fq6_inv(a)
+    for i in range(8):
+        assert tower.fq6_to_ints(got, i) == gold.fq6_inv(xs[i])
+
+
+def test_fq12_ops(rng):
+    xs = [rnd_fq12(rng) for _ in range(4)]
+    ys = [rnd_fq12(rng) for _ in range(4)]
+    a = tower.fq12_stack(xs)
+    b = tower.fq12_stack(ys)
+
+    got = tower.fq12_mul(a, b)
+    for i in range(4):
+        assert tower.fq12_to_ints(got, i) == gold.fq12_mul(xs[i], ys[i])
+
+    got = tower.fq12_sqr(a)
+    for i in range(4):
+        assert tower.fq12_to_ints(got, i) == gold.fq12_sqr(xs[i])
+
+    got = tower.fq12_inv(a)
+    for i in range(4):
+        assert tower.fq12_to_ints(got, i) == gold.fq12_inv(xs[i])
+
+
+def test_fq12_pow_fixed(rng):
+    xs = [rnd_fq12(rng) for _ in range(2)]
+    a = tower.fq12_stack(xs)
+    e = 0xDEADBEEF12345
+    got = tower.fq12_pow_fixed(a, e)
+    for i in range(2):
+        assert tower.fq12_to_ints(got, i) == gold.fq12_pow(xs[i], e)
+
+
+def test_fq12_frobenius(rng):
+    xs = [rnd_fq12(rng) for _ in range(2)]
+    a = tower.fq12_stack(xs)
+    got = tower.fq12_frobenius(a)
+    for i in range(2):
+        want = gold.fq12_pow(xs[i], Q)
+        assert tower.fq12_to_ints(got, i) == want
+
+
+def test_batch_inv_fq2(rng):
+    xs = [rnd_fq2(rng) for _ in range(9)]
+    a = tower.fq2_stack(xs)
+    got = tower.batch_inv_fq2(a)
+    for i in range(9):
+        assert tower.fq2_to_ints(got, i) == gold.fq2_inv(xs[i])
