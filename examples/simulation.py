@@ -101,10 +101,10 @@ class Simulation:
             self._pending_work.append((node.id, work))
         all_ids = sorted(self.nodes)
         for tm in step.messages:
+            t = node.clock + self._msg_delay(tm.message)  # size once per msg
             for to in tm.target.recipients(all_ids, our_id=node.id):
                 self._seq += 1
                 node.sent_msgs += 1
-                t = node.clock + self._msg_delay(tm.message)
                 heapq.heappush(self.events, (t, self._seq, to, node.id, tm.message))
 
     def _flush_work(self) -> None:

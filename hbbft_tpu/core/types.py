@@ -159,6 +159,8 @@ class Step(Generic[NodeId]):
 
     def extend(self, other: "Step") -> "Step":
         """Absorb ``other`` into ``self`` (hbbft `Step::extend` §)."""
+        if not other:
+            return self  # most child steps are empty; skip 4 list ops
         self.output.extend(other.output)
         self.messages.extend(other.messages)
         self.fault_log.extend(other.fault_log)
@@ -222,6 +224,8 @@ def absorb_child_step(
     ``on_output`` — child output -> parent Step (parent's reaction).
     """
     step = Step()
+    if not child_step:
+        return step
     step.messages.extend(tm.map(wrap_msg) for tm in child_step.messages)
     step.fault_log.extend(child_step.fault_log)
     for work in child_step.work:

@@ -19,6 +19,7 @@ polynomial).
 
 from __future__ import annotations
 
+import functools
 from typing import List, Optional, Sequence
 
 import numpy as np
@@ -43,6 +44,16 @@ class GF256:
         exp[255:510] = exp[0:255]
         self.EXP = exp
         self.LOG = log
+        # Plain-int copies: scalar field math (Lagrange matrix setup) on 0-d
+        # numpy arrays is ~50× slower than int list indexing — and matrix
+        # construction dominated N=100 profiles before caching.
+        self._exp = [int(v) for v in exp]
+        self._log = [int(v) for v in log]
+
+    def mul_int(self, a: int, b: int) -> int:
+        if a == 0 or b == 0:
+            return 0
+        return self._exp[self._log[a] + self._log[b]]
 
     def mul(self, a: np.ndarray, b: np.ndarray) -> np.ndarray:
         """Elementwise GF(2⁸) product (uint8 arrays, broadcastable)."""
@@ -85,19 +96,27 @@ class GF256:
 
         In GF(2⁸), subtraction is XOR.
         """
+        mul = self.mul_int
         row = np.zeros(len(xs), dtype=np.uint8)
         for j, xj in enumerate(xs):
             num, den = 1, 1
             for k, xk in enumerate(xs):
                 if k == j:
                     continue
-                num = int(self.mul(num, xk ^ y))
-                den = int(self.mul(den, xk ^ xj))
-            row[j] = int(self.mul(num, self.inv(den)))
+                num = mul(num, xk ^ y)
+                den = mul(den, xk ^ xj)
+            row[j] = mul(num, self._exp[255 - self._log[den]])
         return row
 
     def lagrange_matrix(self, xs: Sequence[int], ys: Sequence[int]) -> np.ndarray:
         """Matrix mapping values at points ``xs`` to values at points ``ys``."""
+        return self._lagrange_matrix_cached(tuple(xs), tuple(ys)).copy()
+
+    @functools.lru_cache(maxsize=4096)
+    def _lagrange_matrix_cached(self, xs: tuple, ys: tuple) -> np.ndarray:
+        """The same (xs, ys) pairs recur across nodes and epochs — every
+        node of a VirtualNet builds identical broadcast/reconstruct
+        matrices (SURVEY.md §2.3 inter-instance parallelism)."""
         if not ys:
             return np.zeros((0, len(xs)), dtype=np.uint8)
         return np.stack([self.lagrange_row(xs, y) for y in ys], axis=0)
@@ -108,6 +127,13 @@ _GF = GF256()
 
 def gf256() -> GF256:
     return _GF
+
+
+@functools.lru_cache(maxsize=256)
+def rs_codec(data_shards: int, parity_shards: int) -> "RSCodec":
+    """Shared codec instances: construction builds Lagrange matrices, and a
+    Subset spawns N Broadcasts per node per epoch with identical (k, m)."""
+    return RSCodec(data_shards, parity_shards)
 
 
 class RSCodec:

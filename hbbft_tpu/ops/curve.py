@@ -201,14 +201,19 @@ def safe_scalar(s: int) -> Tuple[int, bool]:
     return (R - s, True)
 
 
-def scalars_to_bits(scalars: Sequence[int]) -> np.ndarray:
-    """(B, SCALAR_BITS) MSB-first bit matrix (host)."""
-    out = np.zeros((len(scalars), SCALAR_BITS), dtype=np.int32)
+def scalars_to_bits(scalars: Sequence[int], width: int = SCALAR_BITS) -> np.ndarray:
+    """(B, width) MSB-first bit matrix (host).
+
+    A narrower width (e.g. 128 for random-linear-combination coefficients)
+    shortens the device ladder proportionally; any scalar < 2^width < 2^254
+    is automatically ladder-safe (see safe_scalar).
+    """
+    out = np.zeros((len(scalars), width), dtype=np.int32)
     for i, s in enumerate(scalars):
-        if s >> SCALAR_BITS:
-            raise ValueError("scalar too large — run safe_scalar first")
-        for j in range(SCALAR_BITS):
-            out[i, SCALAR_BITS - 1 - j] = (s >> j) & 1
+        if s >> width:
+            raise ValueError("scalar too large for bit width")
+        for j in range(width):
+            out[i, width - 1 - j] = (s >> j) & 1
     return out
 
 
@@ -336,6 +341,35 @@ def _tree_sum(F, P, axis_len: int):
             n = half
         P = summed
     return P
+
+
+def jac_to_affine_g1(P):
+    """Batched Jacobian → affine (x, y, inf) — one Fermat inverse total.
+
+    Infinity lanes get garbage coordinates masked to (0, 1) with inf=True;
+    the Miller loop neutralizes them by flag, never by value.
+    """
+    X, Y, Z, inf = P
+    # Avoid 0-division garbage polluting the batch product: substitute 1.
+    Zsafe = fq.select(inf, _F1.one_like(Z), Z)
+    zinv = fq.batch_inv(Zsafe)
+    zinv2, zinv3 = fq.mul_n([(zinv, zinv), (fq.mul(zinv, zinv), zinv)])
+    x, y = fq.mul_n([(X, zinv2), (Y, zinv3)])
+    x = fq.select(inf, _F1.zeros_like(x), x)
+    y = fq.select(inf, _F1.one_like(y), y)
+    return (x, y, inf)
+
+
+def jac_to_affine_g2(P):
+    X, Y, Z, inf = P
+    Zsafe = tower.fq2_select(inf, _F2.one_like(Z), Z)
+    zinv = tower.batch_inv_fq2(Zsafe)
+    (zinv2,) = tower.fq2_mul_many([(zinv, zinv)])
+    (zinv3,) = tower.fq2_mul_many([(zinv2, zinv)])
+    x, y = tower.fq2_mul_many([(X, zinv2), (Y, zinv3)])
+    x = tower.fq2_select(inf, _F2.zeros_like(x), x)
+    y = tower.fq2_select(inf, _F2.one_like(y), y)
+    return (x, y, inf)
 
 
 def linear_combine_g1(points, bits, negs):

@@ -181,9 +181,31 @@ def neg(a: jnp.ndarray) -> jnp.ndarray:
     return -a
 
 
+def _use_pallas() -> bool:
+    """Route muls through the fused Pallas kernel on TPU (trace-time check).
+
+    The XLA path materializes the banded matrix in HBM; on TPU the Pallas
+    kernel keeps conv+carry+fold in VMEM (~1.3× today, and the tuning
+    surface for the round-2 kernel work — see PERF.md).  Disable with
+    HBBFT_TPU_NO_PALLAS=1.
+    """
+    import os
+
+    if os.environ.get("HBBFT_TPU_NO_PALLAS"):
+        return False
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
 def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     """Full product + reduction.  Inputs may be lazy (|limb| ≤ 2^14ish from
     a few chained adds); they are renormalized before the convolution."""
+    if _use_pallas():
+        from hbbft_tpu.ops import fq_pallas
+
+        return fq_pallas.mul(a, b)
     a = carry3(a)
     b = carry3(b)
     bmat = b[..., _GATHER_IDX] * _GATHER_MASK  # (..., 37, 73)
@@ -259,6 +281,18 @@ def pow_fixed(x: jnp.ndarray, exponent: int) -> jnp.ndarray:
 def inv(x: jnp.ndarray) -> jnp.ndarray:
     """Fermat inverse x^(Q-2).  ~760 muls — amortize with batch_inv."""
     return pow_fixed(x, Q - 2)
+
+
+def batch_inv(x: jnp.ndarray) -> jnp.ndarray:
+    """Invert a batch (leading axis) of nonzero elements with ONE Fermat
+    inverse: parallel prefix/suffix product scans + the Montgomery trick."""
+    prefix = jax.lax.associative_scan(mul, x, axis=0)
+    suffix = jax.lax.associative_scan(mul, x, axis=0, reverse=True)
+    tinv = inv(prefix[-1])
+    one = jnp.broadcast_to(jnp.asarray(ONE), x[:1].shape)
+    pre = jnp.concatenate([one, prefix[:-1]], axis=0)  # prefix_{i-1}
+    suf = jnp.concatenate([suffix[1:], one], axis=0)  # suffix_{i+1}
+    return mul(mul(pre, suf), jnp.broadcast_to(tinv, x.shape))
 
 
 def is_zero_host(limbs) -> bool:

@@ -1,0 +1,134 @@
+"""Pallas TPU kernel: fused Fq limb multiply (conv + carry + fold in VMEM).
+
+The XLA path in fq.mul materializes a (lanes, 37, 73) banded matrix in HBM
+per stacked multiply (~11 KB/lane) — measured HBM-bound on a v5e (batch
+1024 is *slower* than 256).  This kernel keeps the whole pipeline —
+input renormalization, 37-step shifted convolution, carries, both fold
+rounds — in VMEM; HBM traffic drops to the 0.3 KB/lane of the operands
+and result.
+
+Layout inside the kernel is **limbs-on-sublanes, lanes-on-batch**
+((37, T) int32 tiles): every step is then a full-width VPU op or a
+static-offset slice update; nothing touches the lane (=batch) axis, so a
+tile of T lanes runs T field multiplications in lockstep.
+
+The public wrapper keeps fq.py's (..., NLIMBS) layout and transposes at
+the kernel boundary (one read+write per operand — still ~15× less traffic
+than the banded matrix).  Falls back to interpret mode off-TPU, which is
+how the CPU test suite golden-checks it.
+
+Reference analogue: this is the "Pallas pairing kernel" hot path named by
+BASELINE.json / SURVEY.md §7 hard part 1 — the field layer all curve and
+pairing arithmetic bottoms out in.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from hbbft_tpu.ops import fq
+
+TILE = 512  # lanes per grid step: 4 × (8, 128) int32 VPU tiles
+
+# FOLD columns: FOLD_T[:, j] = canonical limbs of 2^(11·(35+j)) mod Q.
+_FOLD_T = np.ascontiguousarray(fq._FOLD_ROWS.T)  # (37, 38)
+
+
+def _carry_cols(x: jnp.ndarray, passes: int = 3) -> jnp.ndarray:
+    """fq.carry3 in limbs-first layout: split all rows but the last."""
+    n = x.shape[0]
+    for _ in range(passes):
+        hi = x >> fq.BITS
+        lo = x & fq.MASK
+        lo = jnp.concatenate([lo[: n - 1], x[n - 1 :]], axis=0)
+        shifted = jnp.concatenate([jnp.zeros_like(hi[:1]), hi[: n - 1]], axis=0)
+        x = lo + shifted
+    return x
+
+
+def _mul_kernel(a_ref, b_ref, fold_ref, out_ref):
+    a = _carry_cols(a_ref[:])  # (37, T), limbs ≤ 2^11+1
+    b = _carry_cols(b_ref[:])
+    fold_t = fold_ref[:]
+
+    # Schoolbook convolution as 37 shifted multiply-accumulates.  Mosaic has
+    # no scatter-add; shift via static zero-pad concatenation instead.
+    t = a.shape[1]
+
+    def zero_rows(n):
+        return jnp.zeros((n, t), dtype=jnp.int32)
+
+    acc = zero_rows(fq.CONV)
+    for i in range(fq.NLIMBS):
+        prod = a[i : i + 1, :] * b  # (37, T)
+        parts = []
+        if i:
+            parts.append(zero_rows(i))
+        parts.append(prod)
+        if fq.CONV - fq.NLIMBS - i:
+            parts.append(zero_rows(fq.CONV - fq.NLIMBS - i))
+        acc = acc + jnp.concatenate(parts, axis=0)
+
+    c = _carry_cols(acc)
+
+    # Fold 1: replace limbs ≥ 35 via 2^(11·(35+j)) mod Q rows (38 of them).
+    hi = c[35:]
+    out = jnp.concatenate(
+        [c[:35], jnp.zeros((fq.NLIMBS - 35, t), dtype=jnp.int32)], axis=0
+    )
+    for j in range(fq.CONV - 35):
+        out = out + fold_t[:, j : j + 1] * hi[j : j + 1, :]
+
+    out = _carry_cols(out)
+
+    # Fold 2: tidy limbs 35, 36.
+    hi2 = out[35:37]
+    out2 = jnp.concatenate(
+        [out[:35], jnp.zeros((2, t), dtype=jnp.int32)], axis=0
+    )
+    for j in range(2):
+        out2 = out2 + fold_t[:, j : j + 1] * hi2[j : j + 1, :]
+
+    out_ref[:] = _carry_cols(out2)
+
+
+@functools.lru_cache(maxsize=None)
+def _mul_call(n_tiles: int, interpret: bool):
+    return pl.pallas_call(
+        _mul_kernel,
+        out_shape=jax.ShapeDtypeStruct((fq.NLIMBS, n_tiles * TILE), jnp.int32),
+        grid=(n_tiles,),
+        in_specs=[
+            pl.BlockSpec((fq.NLIMBS, TILE), lambda i: (0, i)),
+            pl.BlockSpec((fq.NLIMBS, TILE), lambda i: (0, i)),
+            pl.BlockSpec((fq.NLIMBS, fq.CONV - 35), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((fq.NLIMBS, TILE), lambda i: (0, i)),
+        interpret=interpret,
+    )
+
+
+def mul(a: jnp.ndarray, b: jnp.ndarray, interpret: bool = False) -> jnp.ndarray:
+    """Drop-in for fq.mul on TPU: (..., 37) lazy residues in, same out."""
+    shape = jnp.broadcast_shapes(jnp.shape(a), jnp.shape(b))
+    a = jnp.broadcast_to(jnp.asarray(a, jnp.int32), shape)
+    b = jnp.broadcast_to(jnp.asarray(b, jnp.int32), shape)
+    lanes = 1
+    for d in shape[:-1]:
+        lanes *= d
+    flat_a = a.reshape(lanes, fq.NLIMBS).T
+    flat_b = b.reshape(lanes, fq.NLIMBS).T
+    n_tiles = max(1, -(-lanes // TILE))
+    pad = n_tiles * TILE - lanes
+    if pad:
+        flat_a = jnp.pad(flat_a, ((0, 0), (0, pad)))
+        flat_b = jnp.pad(flat_b, ((0, 0), (0, pad)))
+    out = _mul_call(n_tiles, interpret)(flat_a, flat_b, jnp.asarray(_FOLD_T))
+    return out[:, :lanes].T.reshape(shape)

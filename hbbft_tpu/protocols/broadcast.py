@@ -25,7 +25,7 @@ from typing import Any, Dict, Optional, Set, Tuple
 from hbbft_tpu.core.network_info import NetworkInfo
 from hbbft_tpu.core.protocol import ConsensusProtocol
 from hbbft_tpu.core.types import Step, Target, TargetedMessage
-from hbbft_tpu.crypto.erasure import RSCodec
+from hbbft_tpu.crypto.erasure import RSCodec, rs_codec
 from hbbft_tpu.crypto.merkle import MerkleTree, Proof
 
 
@@ -59,7 +59,7 @@ class Broadcast(ConsensusProtocol):
         f = netinfo.num_faulty()
         self.data_shards = n - 2 * f
         self.parity_shards = 2 * f
-        self.codec = RSCodec(self.data_shards, self.parity_shards)
+        self.codec = rs_codec(self.data_shards, self.parity_shards)
         self.echo_sent = False
         self.ready_sent = False
         self.has_value = False  # got proposer's Value (or we are proposer)

@@ -1,0 +1,51 @@
+"""End-to-end HoneyBadger consensus on the REAL device crypto path.
+
+The full protocol stack — threshold encryption, ACS, batched
+pairing-verification of decryption shares, device Lagrange combines — runs
+with TpuBackend (JAX BLS12-381) in round-barrier defer mode, and the
+committed batches must match a MockBackend run's structure.  This is the
+"minimum end-to-end slice" of SURVEY.md §7 proven at the HoneyBadger level.
+
+Host-side golden crypto (encryption, hashing) makes this the slowest test
+in the suite; it runs one epoch at N=4.
+"""
+
+import pytest
+
+from hbbft_tpu.net.virtual_net import NetBuilder
+from hbbft_tpu.ops.backend import TpuBackend
+from hbbft_tpu.protocols.honey_badger import EncryptionSchedule, HoneyBadger
+
+
+@pytest.mark.slow
+def test_honey_badger_epoch_on_device_crypto():
+    net = (
+        NetBuilder(range(4))
+        .num_faulty(1)
+        .backend(TpuBackend())
+        .defer_mode("round")
+        .crank_limit(1_000_000)
+        .using(
+            lambda ni, be: HoneyBadger(
+                ni,
+                be,
+                session_id=b"tpu-hb",
+                encryption_schedule=EncryptionSchedule.always(),
+            )
+        )
+        .build(seed=11)
+    )
+    for i in sorted(net.nodes):
+        net.send_input(i, {"from": i})
+    net.crank_until(
+        lambda n: all(len(node.outputs) >= 1 for node in n.correct_nodes()),
+        max_cranks=500_000,
+    )
+    batches = [node.outputs[0] for node in net.correct_nodes()]
+    assert all(b == batches[0] for b in batches)
+    assert len(batches[0].contributions) >= 3  # ≥ N − f contributions
+    # Every correct node's contribution made it in (validity).
+    for node in net.correct_nodes():
+        assert any(
+            c == {"from": node.id} for c in batches[0].contributions.values()
+        )
