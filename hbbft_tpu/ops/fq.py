@@ -1,38 +1,51 @@
 """Batched BLS12-381 base-field (Fq) limb arithmetic for TPU.
 
 This is the foundation of the device crypto stack (SURVEY.md §7 "hard parts"
-item 1): 381-bit field elements as vectors of **37 limbs × 11 bits** held in
-``int32`` — the widest limb for which a full 37-term schoolbook convolution
-plus reduction fits signed-int32 accumulators with headroom:
+item 1): 381-bit field elements as limb vectors in one of two switchable
+representations (env ``HBBFT_TPU_FQ_BITS``):
 
-    products  ≤ (2^11+ε)^2            ≈ 2^22
-    conv sum  ≤ 37 · 2^22             ≈ 2^27.3   < 2^31  ✓
-    fold sum  ≤ 38 · 2^11 · 2^11.7    ≈ 2^28     < 2^31  ✓
+* **8-bit limbs × 50 in float32** (default) — the MXU/VPU-rate path.  All
+  intermediate integers stay below 2^24, so float32 arithmetic is *exact*:
 
-Representation ("lazy residue"):
+      products  ≤ 257²                  ≈ 2^16.01
+      conv sum  ≤ 50 · 257²             ≈ 2^21.7   < 2^24  ✓
+      fold sum  ≤ 51 · 257 · 255        ≈ 2^21.7   < 2^24  ✓
 
-* An element is any int32 vector ``l[0..36]`` whose value Σ l_i·2^(11i) is
-  congruent to the represented element mod Q.  Limbs may be negative
-  (subtraction never borrows; signs ride along) and the value may exceed Q —
-  reduction keeps |value| < 2^394 ≈ 2^13·Q, and every op tolerates inputs
-  with |value| up to ~2^398 (a dozen chained lazy adds); vectors outside
-  that envelope (e.g. all 37 limbs at MASK ⇒ 2^407) are out of domain.
-* ``carry3`` renormalizes limbs to [-1, 2^11+1) in three data-independent
-  vector passes (no sequential scan — carries shrink geometrically from the
-  2^28 bound).  The TOP limb is never split, so no carry is ever dropped.
+  Float32 multiply-adds run at full VPU rate (int32 multiplies are
+  emulated multi-op on TPU) and the convolution/fold matmuls are eligible
+  for the MXU — this representation exists purely because of that.
+
+* **11-bit limbs × 37 in int32** — the original conservative path, kept as
+  a second independent implementation for golden cross-checking:
+
+      products  ≤ (2^11+ε)^2            ≈ 2^22
+      conv sum  ≤ 37 · 2^22             ≈ 2^27.3   < 2^31  ✓
+      fold sum  ≤ 38 · 2^11 · 2^11.7    ≈ 2^28     < 2^31  ✓
+
+Representation ("lazy residue"), identical in both widths:
+
+* An element is any limb vector ``l[0..NLIMBS-1]`` whose value
+  Σ l_i·2^(BITS·i) is congruent to the represented element mod Q.  Limbs
+  may be negative (subtraction never borrows; signs ride along) and the
+  value may exceed Q — reduction keeps |value| < 2^(BITS·(FOLD_FROM+2))ish,
+  and every op tolerates inputs with a dozen chained lazy adds; vectors at
+  the full 2^(BITS·NLIMBS) capacity are out of domain.
+* ``carry3`` renormalizes limbs to [-1, BASE+1) in three data-independent
+  vector passes (no sequential scan — carries shrink geometrically).  The
+  TOP limb is never split, so no carry is ever dropped.
 * There is deliberately **no canonical reduction on device**: protocols need
   booleans and byte-strings only at the host seam, where ``to_int`` does an
   exact Python-int mod-Q.  This removes every sequential carry chain from
   the jitted graph (SURVEY.md §7 hard part 6: fixed reduction orders).
 
 Multiplication is convolution expressed as one gather + one small matmul:
-``Bmat[i,k] = b[k-i]`` (37×73, built with a precomputed index/mask pair),
-then ``c = a @ Bmat`` — XLA turns the batch of these into large int32
+``Bmat[i,k] = b[k-i]`` (NLIMBS×CONV, built with a precomputed index/mask
+pair), then ``c = a @ Bmat`` — XLA turns the batch of these into large
 dot-generals, the MXU/VPU-friendly shape the whole design targets.
 
-Reduction mod Q folds limbs ≥ 35 through precomputed rows
-``FOLD[j] = limbs(2^(11·(35+j)) mod Q)`` — again a matmul.  Two fold rounds
-bring any 73-limb convolution back to the 37-limb lazy invariant.
+Reduction mod Q folds limbs ≥ FOLD_FROM through precomputed rows
+``FOLD[j] = limbs(2^(BITS·(FOLD_FROM+j)) mod Q)`` — again a matmul.  Two
+fold rounds bring any CONV-limb convolution back to the lazy invariant.
 
 Reference analogue: the `ff`/`pairing` crates' 64-bit limb arithmetic under
 `threshold_crypto` (SURVEY.md §2.2) — redesigned for a carry-less SIMD ISA
@@ -41,7 +54,7 @@ instead of scalar add-with-carry.
 
 from __future__ import annotations
 
-from functools import partial
+import os
 from typing import Tuple
 
 import numpy as np
@@ -51,45 +64,60 @@ import jax.numpy as jnp
 
 from hbbft_tpu.crypto.field import Q
 
-BITS = 11
+BITS = int(os.environ.get("HBBFT_TPU_FQ_BITS", "8"))
+if BITS == 8:
+    NLIMBS = 50  # 50·8 = 400 bits capacity; values stay below 2^396.
+    FOLD_FROM = 48  # 2^(8·48) = 2^384 > Q ≈ 2^381.4
+    DTYPE = jnp.float32
+    NP_DTYPE = np.float32
+elif BITS == 11:
+    NLIMBS = 37  # 37·11 = 407 bits capacity; values stay below 2^394.
+    FOLD_FROM = 35  # 2^(11·35) = 2^385 > Q
+    DTYPE = jnp.int32
+    NP_DTYPE = np.int32
+else:  # pragma: no cover - configuration error
+    raise ValueError(f"HBBFT_TPU_FQ_BITS must be 8 or 11, got {BITS}")
+
 BASE = 1 << BITS
 MASK = BASE - 1
-NLIMBS = 37  # 37·11 = 407 bits capacity; values stay below 2^394.
-CONV = 2 * NLIMBS - 1  # 73
+CONV = 2 * NLIMBS - 1
+_INV_BASE = 1.0 / BASE  # exact power of two
 
 
 def _int_to_limbs(x: int, n: int = NLIMBS) -> np.ndarray:
     """Canonical little-endian limb decomposition of a non-negative int."""
     if x < 0:
         raise ValueError("canonical limbs are non-negative")
-    out = np.zeros(n, dtype=np.int32)
+    out = np.zeros(n, dtype=np.int64)
     for i in range(n):
         out[i] = x & MASK
         x >>= BITS
     if x:
         raise ValueError("value does not fit limb vector")
-    return out
+    return out.astype(NP_DTYPE)
 
 
 # -- precomputed constants ---------------------------------------------------
 
-# Gather/mask pair turning b (37 limbs) into the banded matrix Bmat[i, k] =
-# b[k-i], so that (a @ Bmat)[k] = Σ_i a_i·b_{k-i} — the full product.
-_K = np.arange(CONV)[None, :]  # (1, 73)
-_I = np.arange(NLIMBS)[:, None]  # (37, 1)
-_GATHER_IDX = np.clip(_K - _I, 0, NLIMBS - 1).astype(np.int32)  # (37, 73)
-_GATHER_MASK = ((_K - _I >= 0) & (_K - _I < NLIMBS)).astype(np.int32)
+# Gather/mask pair turning b (NLIMBS limbs) into the banded matrix
+# Bmat[i, k] = b[k-i], so that (a @ Bmat)[k] = Σ_i a_i·b_{k-i}.
+_K = np.arange(CONV)[None, :]  # (1, CONV)
+_I = np.arange(NLIMBS)[:, None]  # (NLIMBS, 1)
+_GATHER_IDX = np.clip(_K - _I, 0, NLIMBS - 1).astype(np.int32)
+_GATHER_MASK = ((_K - _I >= 0) & (_K - _I < NLIMBS)).astype(NP_DTYPE)
 
-# FOLD[j] = canonical limbs of (2^(11·(35+j)) mod Q), j = 0..37: replaces
-# limb positions ≥ 35 by their mod-Q equivalents.  Position 35 (2^385) is
-# already > Q, so folding from 35 keeps the value bound tight (< 2^394).
+# FOLD[j] = canonical limbs of (2^(BITS·(FOLD_FROM+j)) mod Q): replaces limb
+# positions ≥ FOLD_FROM by their mod-Q equivalents.
 _FOLD_ROWS = np.stack(
-    [_int_to_limbs(pow(1 << BITS, 35 + j, Q)) for j in range(NLIMBS + 1)]
-)  # (38, 37)
+    [
+        _int_to_limbs(pow(1 << BITS, FOLD_FROM + j, Q))
+        for j in range(CONV - FOLD_FROM)
+    ]
+)  # (CONV - FOLD_FROM, NLIMBS)
 
 Q_LIMBS = _int_to_limbs(Q)
 
-ZERO = np.zeros(NLIMBS, dtype=np.int32)
+ZERO = np.zeros(NLIMBS, dtype=NP_DTYPE)
 ONE = _int_to_limbs(1)
 
 
@@ -111,7 +139,7 @@ def to_int(limbs) -> int:
     arr = np.asarray(limbs)
     val = 0
     for i in range(arr.shape[-1] - 1, -1, -1):
-        val = (val << BITS) + int(arr[..., i])
+        val = (val << BITS) + int(round(float(arr[..., i])))
     return val % Q
 
 
@@ -123,17 +151,30 @@ def to_ints(batch) -> list:
 # -- core ops (all jnp, batch-agnostic over leading dims) --------------------
 
 
+def _split(x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(hi, lo) with x = hi·BASE + lo, lo ∈ [0, BASE) — exact both dtypes.
+
+    int32 uses shift/mask (arithmetic shift floors negatives correctly);
+    float32 uses an exact power-of-two scale + floor.  Float inputs must be
+    integer-valued with |x| < 2^24 (all callers guarantee this).
+    """
+    if DTYPE == jnp.int32:
+        return x >> BITS, x & MASK
+    hi = jnp.floor(x * _INV_BASE)
+    return hi, x - hi * BASE
+
+
 def carry3(x: jnp.ndarray) -> jnp.ndarray:
     """Three vectorized carry passes: limbs land in [-1, BASE+1].
 
-    Works for any |limb| ≤ 2^30.  The top limb accumulates incoming carries
-    without being split (its magnitude stays tiny because values are
-    < 2^394 ≪ 2^(11·36)), so nothing is ever truncated.
+    Works for any limb magnitude up to the dtype's exact-integer envelope
+    (2^30 int32 / 2^24 float32).  The top limb accumulates incoming carries
+    without being split (its magnitude stays tiny because reduced values
+    are far below 2^(BITS·(NLIMBS-1))), so nothing is ever truncated.
     """
-    x = jnp.asarray(x)
+    x = jnp.asarray(x, DTYPE)
     for _ in range(3):
-        hi = x >> BITS  # arithmetic shift: correct floor for negatives
-        lo = x & MASK
+        hi, lo = _split(x)
         # Keep the top limb whole.
         lo = lo.at[..., -1].set(x[..., -1])
         shifted = jnp.concatenate(
@@ -144,14 +185,15 @@ def carry3(x: jnp.ndarray) -> jnp.ndarray:
 
 
 def _fold(c: jnp.ndarray, rows: jnp.ndarray) -> jnp.ndarray:
-    """Replace limbs ≥ 35 via precomputed (2^(11·(35+j)) mod Q) rows."""
-    lo = c[..., :35]
-    hi = c[..., 35:]
+    """Replace limbs ≥ FOLD_FROM via the precomputed mod-Q rows."""
+    lo = c[..., :FOLD_FROM]
+    hi = c[..., FOLD_FROM:]
     lo = jnp.concatenate(
-        [lo, jnp.zeros(lo.shape[:-1] + (NLIMBS - 35,), dtype=lo.dtype)], axis=-1
+        [lo, jnp.zeros(lo.shape[:-1] + (NLIMBS - FOLD_FROM,), dtype=lo.dtype)],
+        axis=-1,
     )
     return lo + jnp.einsum(
-        "...j,jk->...k", hi, rows[: hi.shape[-1]], preferred_element_type=jnp.int32
+        "...j,jk->...k", hi, rows[: hi.shape[-1]], preferred_element_type=DTYPE
     )
 
 
@@ -159,11 +201,11 @@ _FOLD_J = jnp.asarray(_FOLD_ROWS)
 
 
 def reduce_conv(c: jnp.ndarray) -> jnp.ndarray:
-    """73-limb convolution output → 37-limb lazy residue."""
+    """CONV-limb convolution output → NLIMBS-limb lazy residue."""
     c = carry3(c)  # limbs ≤ BASE+1
-    c = _fold(c, _FOLD_J)  # 73 → 37 limbs, |value| < 2^398
+    c = _fold(c, _FOLD_J)  # CONV → NLIMBS limbs
     c = carry3(c)
-    c = _fold(c, _FOLD_J)  # tidy limbs 35,36 → |value| < 2^394
+    c = _fold(c, _FOLD_J)  # tidy limbs ≥ FOLD_FROM
     return carry3(c)
 
 
@@ -185,12 +227,9 @@ def _use_pallas() -> bool:
     """Route muls through the fused Pallas kernel on TPU (trace-time check).
 
     The XLA path materializes the banded matrix in HBM; on TPU the Pallas
-    kernel keeps conv+carry+fold in VMEM (~1.3× today, and the tuning
-    surface for the round-2 kernel work — see PERF.md).  Disable with
+    kernel keeps conv+carry+fold in VMEM.  Disable with
     HBBFT_TPU_NO_PALLAS=1.
     """
-    import os
-
     if os.environ.get("HBBFT_TPU_NO_PALLAS"):
         return False
     try:
@@ -200,18 +239,27 @@ def _use_pallas() -> bool:
 
 
 def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    """Full product + reduction.  Inputs may be lazy (|limb| ≤ 2^14ish from
-    a few chained adds); they are renormalized before the convolution."""
+    """Full product + reduction.  Inputs may be lazy (limbs grown by a few
+    chained adds); they are renormalized before the convolution."""
     if _use_pallas():
         from hbbft_tpu.ops import fq_pallas
 
         return fq_pallas.mul(a, b)
     a = carry3(a)
     b = carry3(b)
-    bmat = b[..., _GATHER_IDX] * _GATHER_MASK  # (..., 37, 73)
-    c = jnp.einsum(
-        "...i,...ik->...k", a, bmat, preferred_element_type=jnp.int32
-    )
+    bmat = b[..., _GATHER_IDX] * jnp.asarray(_GATHER_MASK)
+    if DTYPE == jnp.float32:
+        # Post-carry3 limbs lie in [-1, BASE+1] ⊂ bf16-exact integers, so the
+        # banded contraction is a native bf16×bf16→f32 MXU dot: products are
+        # exact (8-bit × 8-bit mantissas) and the 50-term sums stay < 2^24.
+        c = jnp.einsum(
+            "...i,...ik->...k",
+            a.astype(jnp.bfloat16),
+            bmat.astype(jnp.bfloat16),
+            preferred_element_type=jnp.float32,
+        )
+    else:
+        c = jnp.einsum("...i,...ik->...k", a, bmat, preferred_element_type=DTYPE)
     return reduce_conv(c)
 
 
@@ -241,19 +289,25 @@ def mul_n(pairs) -> list:
 
 
 def mul_small(a: jnp.ndarray, k: int) -> jnp.ndarray:
-    """Multiply by a small non-negative int (|k| < 2^15)."""
-    return reduce_small(a * jnp.int32(k))
+    """Multiply by a small int k, |k| < 2^15 (k may be negative).
+
+    The input is renormalized first so the scaled limbs stay inside the
+    float32 exact-integer envelope (257 · 2^15 < 2^24).
+    """
+    if not -(1 << 15) < k < (1 << 15):
+        raise ValueError("|k| must be < 2^15")
+    return reduce_small(carry3(a) * jnp.asarray(k, DTYPE))
 
 
 def reduce_small(x: jnp.ndarray) -> jnp.ndarray:
-    """Renormalize a 37-limb vector whose limbs grew (adds, small scalars)."""
+    """Renormalize a NLIMBS-limb vector whose limbs grew (adds, scalars)."""
     x = carry3(x)
     x = _fold(x, _FOLD_J)
     return carry3(x)
 
 
 def select(cond: jnp.ndarray, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    """Branchless per-item select; cond shape broadcasts against (..., 37)."""
+    """Branchless per-item select; cond shape broadcasts against (..., NLIMBS)."""
     return jnp.where(cond[..., None], a, b)
 
 

@@ -1,19 +1,22 @@
 """Pallas TPU kernel: fused Fq limb multiply (conv + carry + fold in VMEM).
 
-The XLA path in fq.mul materializes a (lanes, 37, 73) banded matrix in HBM
-per stacked multiply (~11 KB/lane) — measured HBM-bound on a v5e (batch
-1024 is *slower* than 256).  This kernel keeps the whole pipeline —
-input renormalization, 37-step shifted convolution, carries, both fold
-rounds — in VMEM; HBM traffic drops to the 0.3 KB/lane of the operands
-and result.
+The XLA path in fq.mul materializes a (lanes, NLIMBS, CONV) banded matrix
+in HBM per stacked multiply — measured HBM-bound on a v5e (batch 1024 is
+*slower* than 256).  This kernel keeps the whole pipeline — input
+renormalization, shifted convolution, carries, both fold rounds — in VMEM;
+HBM traffic drops to the operands and result.
 
 Layout inside the kernel is **limbs-on-sublanes, lanes-on-batch**
-((37, T) int32 tiles): every step is then a full-width VPU op or a
+((NLIMBS, T) tiles): every step is then a full-width VPU op or a
 static-offset slice update; nothing touches the lane (=batch) axis, so a
 tile of T lanes runs T field multiplications in lockstep.
 
+The kernel is generic over fq's limb representation (8-bit/float32 —
+default, full-rate VPU FMAs — or 11-bit/int32).  The fold step is a small
+matmul (jnp.dot) so it can ride the MXU in the float32 configuration.
+
 The public wrapper keeps fq.py's (..., NLIMBS) layout and transposes at
-the kernel boundary (one read+write per operand — still ~15× less traffic
+the kernel boundary (one read+write per operand — still far less traffic
 than the banded matrix).  Falls back to interpret mode off-TPU, which is
 how the CPU test suite golden-checks it.
 
@@ -25,6 +28,7 @@ pairing arithmetic bottoms out in.
 from __future__ import annotations
 
 import functools
+import os
 
 import numpy as np
 
@@ -35,39 +39,44 @@ from jax.experimental.pallas import tpu as pltpu
 
 from hbbft_tpu.ops import fq
 
-TILE = 512  # lanes per grid step: 4 × (8, 128) int32 VPU tiles
+TILE = 512  # lanes per grid step: 4 × (8, 128) VPU tiles
 
-# FOLD columns: FOLD_T[:, j] = canonical limbs of 2^(11·(35+j)) mod Q.
-_FOLD_T = np.ascontiguousarray(fq._FOLD_ROWS.T)  # (37, 38)
+# Convolution strategy inside the kernel: "concat" builds each shifted
+# partial product as zero-pad concatenations (functional, many VMEM
+# copies); "scratch" accumulates into a VMEM scratch ref with static-slice
+# read-modify-writes (one pass of traffic).  Selectable for A/B timing.
+_CONV_MODE = os.environ.get("HBBFT_TPU_CONV_MODE", "scratch")
+
+# FOLD columns: FOLD_T[:, j] = canonical limbs of 2^(BITS·(FOLD_FROM+j)) mod Q.
+_FOLD_T = np.ascontiguousarray(fq._FOLD_ROWS.T)  # (NLIMBS, CONV - FOLD_FROM)
 
 
 def _carry_cols(x: jnp.ndarray, passes: int = 3) -> jnp.ndarray:
     """fq.carry3 in limbs-first layout: split all rows but the last."""
     n = x.shape[0]
     for _ in range(passes):
-        hi = x >> fq.BITS
-        lo = x & fq.MASK
+        if fq.DTYPE == jnp.int32:
+            hi = x >> fq.BITS
+            lo = x & fq.MASK
+        else:
+            hi = jnp.floor(x * fq._INV_BASE)
+            lo = x - hi * fq.BASE
         lo = jnp.concatenate([lo[: n - 1], x[n - 1 :]], axis=0)
         shifted = jnp.concatenate([jnp.zeros_like(hi[:1]), hi[: n - 1]], axis=0)
         x = lo + shifted
     return x
 
 
-def _mul_kernel(a_ref, b_ref, fold_ref, out_ref):
-    a = _carry_cols(a_ref[:])  # (37, T), limbs ≤ 2^11+1
-    b = _carry_cols(b_ref[:])
-    fold_t = fold_ref[:]
-
-    # Schoolbook convolution as 37 shifted multiply-accumulates.  Mosaic has
-    # no scatter-add; shift via static zero-pad concatenation instead.
+def _conv_concat(a, b):
+    """Shifted multiply-accumulate via zero-pad concatenations."""
     t = a.shape[1]
 
     def zero_rows(n):
-        return jnp.zeros((n, t), dtype=jnp.int32)
+        return jnp.zeros((n, t), dtype=fq.DTYPE)
 
     acc = zero_rows(fq.CONV)
     for i in range(fq.NLIMBS):
-        prod = a[i : i + 1, :] * b  # (37, T)
+        prod = a[i : i + 1, :] * b  # (NLIMBS, T)
         parts = []
         if i:
             parts.append(zero_rows(i))
@@ -75,51 +84,69 @@ def _mul_kernel(a_ref, b_ref, fold_ref, out_ref):
         if fq.CONV - fq.NLIMBS - i:
             parts.append(zero_rows(fq.CONV - fq.NLIMBS - i))
         acc = acc + jnp.concatenate(parts, axis=0)
+    return acc
 
-    c = _carry_cols(acc)
 
-    # Fold 1: replace limbs ≥ 35 via 2^(11·(35+j)) mod Q rows (38 of them).
-    hi = c[35:]
+def _mul_kernel(a_ref, b_ref, fold_ref, out_ref, acc_ref=None):
+    a = _carry_cols(a_ref[:])  # (NLIMBS, T), limbs ≤ BASE+1
+    b = _carry_cols(b_ref[:])
+    fold_t = fold_ref[:]
+    ff = fq.FOLD_FROM
+    t = a.shape[1]
+
+    if acc_ref is None:
+        c = _conv_concat(a, b)
+    else:
+        # One-pass accumulation into VMEM scratch: each step is a 50-row
+        # static-slice read-modify-write instead of a 99-row concat+add.
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        for i in range(fq.NLIMBS):
+            acc_ref[i : i + fq.NLIMBS, :] += a[i : i + 1, :] * b
+        c = acc_ref[:]
+    c = _carry_cols(c)
+
+    # Fold 1: replace limbs ≥ FOLD_FROM via the mod-Q rows — one small
+    # matmul (NLIMBS, CONV-FOLD_FROM) @ (CONV-FOLD_FROM, T).
     out = jnp.concatenate(
-        [c[:35], jnp.zeros((fq.NLIMBS - 35, t), dtype=jnp.int32)], axis=0
-    )
-    for j in range(fq.CONV - 35):
-        out = out + fold_t[:, j : j + 1] * hi[j : j + 1, :]
+        [c[:ff], jnp.zeros((fq.NLIMBS - ff, t), dtype=fq.DTYPE)], axis=0
+    ) + jnp.dot(fold_t, c[ff:], preferred_element_type=fq.DTYPE)
 
     out = _carry_cols(out)
 
-    # Fold 2: tidy limbs 35, 36.
-    hi2 = out[35:37]
+    # Fold 2: tidy limbs ≥ FOLD_FROM (NLIMBS - FOLD_FROM of them).
+    nhi = fq.NLIMBS - ff
     out2 = jnp.concatenate(
-        [out[:35], jnp.zeros((2, t), dtype=jnp.int32)], axis=0
-    )
-    for j in range(2):
-        out2 = out2 + fold_t[:, j : j + 1] * hi2[j : j + 1, :]
+        [out[:ff], jnp.zeros((nhi, t), dtype=fq.DTYPE)], axis=0
+    ) + jnp.dot(fold_t[:, :nhi], out[ff:], preferred_element_type=fq.DTYPE)
 
     out_ref[:] = _carry_cols(out2)
 
 
 @functools.lru_cache(maxsize=None)
 def _mul_call(n_tiles: int, interpret: bool):
+    scratch = []
+    if _CONV_MODE == "scratch":
+        scratch = [pltpu.VMEM((fq.CONV, TILE), fq.DTYPE)]
     return pl.pallas_call(
         _mul_kernel,
-        out_shape=jax.ShapeDtypeStruct((fq.NLIMBS, n_tiles * TILE), jnp.int32),
+        out_shape=jax.ShapeDtypeStruct((fq.NLIMBS, n_tiles * TILE), fq.DTYPE),
         grid=(n_tiles,),
         in_specs=[
             pl.BlockSpec((fq.NLIMBS, TILE), lambda i: (0, i)),
             pl.BlockSpec((fq.NLIMBS, TILE), lambda i: (0, i)),
-            pl.BlockSpec((fq.NLIMBS, fq.CONV - 35), lambda i: (0, 0)),
+            pl.BlockSpec((fq.NLIMBS, fq.CONV - fq.FOLD_FROM), lambda i: (0, 0)),
         ],
         out_specs=pl.BlockSpec((fq.NLIMBS, TILE), lambda i: (0, i)),
+        scratch_shapes=scratch,
         interpret=interpret,
     )
 
 
 def mul(a: jnp.ndarray, b: jnp.ndarray, interpret: bool = False) -> jnp.ndarray:
-    """Drop-in for fq.mul on TPU: (..., 37) lazy residues in, same out."""
+    """Drop-in for fq.mul on TPU: (..., NLIMBS) lazy residues in, same out."""
     shape = jnp.broadcast_shapes(jnp.shape(a), jnp.shape(b))
-    a = jnp.broadcast_to(jnp.asarray(a, jnp.int32), shape)
-    b = jnp.broadcast_to(jnp.asarray(b, jnp.int32), shape)
+    a = jnp.broadcast_to(jnp.asarray(a, fq.DTYPE), shape)
+    b = jnp.broadcast_to(jnp.asarray(b, fq.DTYPE), shape)
     lanes = 1
     for d in shape[:-1]:
         lanes *= d

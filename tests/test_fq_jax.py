@@ -52,7 +52,7 @@ def test_mul_lazy_inputs(rng):
     """Products of un-carried sums/differences must still be exact."""
     xs, ys, zs = (rnd_ints(rng, 16) for _ in range(3))
     a, b, c = fq.from_ints(xs), fq.from_ints(ys), fq.from_ints(zs)
-    lazy1 = fq.add(fq.add(a, b), c)  # limbs up to ~3·2^11
+    lazy1 = fq.add(fq.add(a, b), c)  # limbs up to ~3·BASE
     lazy2 = fq.sub(fq.sub(a, b), c)  # negative limbs
     got = fq.to_ints(np.asarray(fq.mul(lazy1, lazy2)))
     want = [
@@ -62,14 +62,14 @@ def test_mul_lazy_inputs(rng):
 
 
 def test_mul_worst_case_limbs():
-    """Worst in-domain lazy limbs (|value| < 2^395) stay exact through mul.
+    """Worst in-domain lazy limbs stay exact through mul.
 
-    All-max limbs in positions 0..34 put the value right at the fold
-    boundary; the negated variant exercises the signed path.
+    All-max limbs in positions 0..FOLD_FROM-1 put the value right at the
+    fold boundary; the negated variant exercises the signed path.
     """
-    worst = np.zeros((4, fq.NLIMBS), dtype=np.int32)
-    worst[:2, :35] = fq.MASK
-    worst[2:, :35] = -fq.MASK
+    worst = np.zeros((4, fq.NLIMBS), dtype=fq.NP_DTYPE)
+    worst[:2, : fq.FOLD_FROM] = fq.MASK
+    worst[2:, : fq.FOLD_FROM] = -fq.MASK
     vals = [fq.to_int(w) for w in worst]
     got = fq.to_ints(np.asarray(fq.mul(worst, worst[::-1].copy())))
     assert got == [(a * b) % Q for a, b in zip(vals, vals[::-1])]

@@ -1,0 +1,42 @@
+"""Cross-check the two Fq limb representations.
+
+The default build uses 8-bit limbs in float32 (MXU/VPU-rate path); the
+11-bit int32 representation is kept as an independent implementation of the
+same field (SURVEY.md §7 hard part 1: golden-test every layer).  The limb
+width is fixed at import time by HBBFT_TPU_FQ_BITS, so the non-default
+width runs in a subprocess.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.parametrize("bits", ["8", "11"])
+def test_fq_suite_under_width(bits):
+    env = dict(os.environ)
+    env["HBBFT_TPU_FQ_BITS"] = bits
+    proc = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "pytest",
+            "-x",
+            "-q",
+            os.path.join(_REPO, "tests", "test_fq_jax.py"),
+            os.path.join(_REPO, "tests", "test_fq_pallas.py"),
+        ],
+        env=env,
+        capture_output=True,
+        text=True,
+        cwd=_REPO,
+        timeout=1200,
+    )
+    assert proc.returncode == 0, (
+        f"fq suite failed under {bits}-bit limbs:\n{proc.stdout[-3000:]}"
+        f"\n{proc.stderr[-2000:]}"
+    )
