@@ -46,6 +46,11 @@ class CryptoBackend(abc.ABC):
 
     def __init__(self, group: Group) -> None:
         self.group = group
+        from hbbft_tpu.utils.metrics import Counters
+
+        #: operative-metric tallies (SURVEY.md §5): shares verified/combined,
+        #: pairing checks, device dispatches.
+        self.counters = Counters()
 
     # -- key material --------------------------------------------------------
 
@@ -61,6 +66,9 @@ class CryptoBackend(abc.ABC):
         self, items: Sequence[Tuple[PublicKeyShare, bytes, SignatureShare]]
     ) -> List[bool]:
         """Verify a batch of (pk_share, document, sig_share) triples."""
+        c = self.counters
+        c.sig_shares_verified += len(items)
+        c.pairing_checks += len(items)
         out = []
         for pk, doc, share in items:
             out.append(pk.verify_sig_share(share, doc))
@@ -70,6 +78,9 @@ class CryptoBackend(abc.ABC):
         self, items: Sequence[Tuple[PublicKeyShare, Ciphertext, DecryptionShare]]
     ) -> List[bool]:
         """Verify a batch of (pk_share, ciphertext, dec_share) triples."""
+        c = self.counters
+        c.dec_shares_verified += len(items)
+        c.pairing_checks += len(items)
         out = []
         for pk, ct, share in items:
             out.append(pk.verify_decryption_share(share, ct))
@@ -80,21 +91,36 @@ class CryptoBackend(abc.ABC):
     ) -> List[bool]:
         """Verify a batch of full (public_key, message, signature) triples
         (per-node vote/key-gen signatures — SURVEY.md §3.2 DHB path)."""
+        self.counters.signatures_verified += len(items)
+        self.counters.pairing_checks += len(items)
         return [pk.verify(sig, msg) for pk, msg, sig in items]
 
     def verify_ciphertexts(self, items: Sequence[Ciphertext]) -> List[bool]:
+        self.counters.ciphertexts_verified += len(items)
+        self.counters.pairing_checks += len(items)
         return [ct.verify() for ct in items]
 
     # -- combination ---------------------------------------------------------
 
     def combine_signatures(
-        self, pk_set: PublicKeySet, shares: Dict[int, SignatureShare]
+        self,
+        pk_set: PublicKeySet,
+        shares: Dict[int, SignatureShare],
+        doc: Optional[bytes] = None,
     ) -> Signature:
+        """Lagrange-combine ≥ threshold+1 verified shares into a signature.
+
+        `doc` (the signed document) is optional context: host backends
+        ignore it, device backends use it to re-verify the combined
+        signature against the master public key (defense in depth for the
+        batched ladder path)."""
+        self.counters.sig_shares_combined += len(shares)
         return pk_set.combine_signatures(shares)
 
     def combine_decryption_shares(
         self, pk_set: PublicKeySet, shares: Dict[int, DecryptionShare], ct: Ciphertext
     ) -> bytes:
+        self.counters.dec_shares_combined += len(shares)
         return pk_set.combine_decryption_shares(shares, ct)
 
     # -- misc ----------------------------------------------------------------

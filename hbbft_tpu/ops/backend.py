@@ -156,6 +156,8 @@ class TpuBackend(CryptoBackend):
         n = len(quads)
         if n == 0:
             return []
+        self.counters.pairing_checks += n
+        self.counters.device_dispatches += 1
         g1 = self.group.g1()
         g2 = self.group.g2()
         pad = (g1, g2, g1, g2)  # trivially true
@@ -208,12 +210,16 @@ class TpuBackend(CryptoBackend):
         build_group_arrays,
         jitted,
         results: List,
+        direct_quad,
     ) -> None:
         """Run RLC group checks; write per-item booleans into `results`.
 
         `build_group_arrays(flat_padded_groups, g, k, group_keys) -> args`
         constructs the jitted fn's inputs; padding inside each group uses
         (None point, scalar 0) lanes that contribute the identity.
+        `direct_quad(item)` builds the per-item pairing quad for the exact
+        fallback when a group check fails (passed explicitly so concurrent
+        sig/dec verifications on one backend can't cross wires).
         """
         if not groups:
             return
@@ -232,6 +238,8 @@ class TpuBackend(CryptoBackend):
             [curve.scalars_to_bits(row, self.RLC_BITS) for row in scalars]
         )
 
+        self.counters.rlc_groups += len(groups)
+        self.counters.device_dispatches += 1
         args = build_group_arrays(padded, g, k)
         f = jitted(*args, jnp.asarray(rbits))
         f = jax.tree_util.tree_map(np.asarray, f)
@@ -242,16 +250,12 @@ class TpuBackend(CryptoBackend):
             else:
                 # Attribute faults exactly: per-item fallback.
                 sub = self._check_batch(
-                    [self._direct_quad(items[idx]) for idx in grp]
+                    [direct_quad(items[idx]) for idx in grp]
                 )
                 for idx, ok in zip(grp, sub):
                     results[idx] = ok
 
     # -- batched verification ------------------------------------------------
-
-    def _direct_quad(self, item):
-        """(a1, b1, a2, b2) for one sig-share/dec-share item (set per call)."""
-        raise RuntimeError("set by the calling verify method")
 
     def verify_sig_shares(
         self, items: Sequence[Tuple[PublicKeyShare, bytes, SignatureShare]]
@@ -262,7 +266,7 @@ class TpuBackend(CryptoBackend):
             pk, doc, share = item
             return (g1, share.el, pk.el, self._hash_g2(doc))
 
-        self._direct_quad = direct  # type: ignore[method-assign]
+        self.counters.sig_shares_verified += len(items)
         n = len(items)
         results: List[Optional[bool]] = [None] * n
 
@@ -313,12 +317,13 @@ class TpuBackend(CryptoBackend):
         def jitted(S_jac, PK_jac, neg_g1, H, rbits):
             return _jitted_rlc_sig()(S_jac, PK_jac, rbits, neg_g1, H)
 
-        self._grouped_rlc(rlc_groups, items, build, jitted, results)
+        self._grouped_rlc(rlc_groups, items, build, jitted, results, direct)
         return [bool(r) for r in results]
 
     def verify_signatures(
         self, items: Sequence[Tuple[Any, bytes, Signature]]
     ) -> List[bool]:
+        self.counters.signatures_verified += len(items)
         g1 = self.group.g1()
         quads = [
             (g1, sig.el, pk.el, self._hash_g2(msg)) for pk, msg, sig in items
@@ -333,7 +338,7 @@ class TpuBackend(CryptoBackend):
             h = self._hash_g2(self.group.g1_to_bytes(ct.u) + ct.v)
             return (share.el, h, pk.el, ct.w)
 
-        self._direct_quad = direct  # type: ignore[method-assign]
+        self.counters.dec_shares_verified += len(items)
         n = len(items)
         results: List[Optional[bool]] = [None] * n
 
@@ -385,10 +390,11 @@ class TpuBackend(CryptoBackend):
         def jitted(D_jac, PK_jac, H, W, rbits):
             return _jitted_rlc_dec()(D_jac, PK_jac, rbits, H, W)
 
-        self._grouped_rlc(rlc_groups, items, build, jitted, results)
+        self._grouped_rlc(rlc_groups, items, build, jitted, results, direct)
         return [bool(r) for r in results]
 
     def verify_ciphertexts(self, items: Sequence[Ciphertext]) -> List[bool]:
+        self.counters.ciphertexts_verified += len(items)
         g1 = self.group.g1()
         quads = []
         for ct in items:
@@ -428,16 +434,31 @@ class TpuBackend(CryptoBackend):
         )
 
     def combine_signatures(
-        self, pk_set: PublicKeySet, shares: Dict[int, SignatureShare]
+        self,
+        pk_set: PublicKeySet,
+        shares: Dict[int, SignatureShare],
+        doc: Optional[bytes] = None,
     ) -> Signature:
         if len(shares) <= pk_set.threshold():
             raise CryptoError(
                 f"need {pk_set.threshold() + 1} shares, got {len(shares)}"
             )
+        self.counters.sig_shares_combined += len(shares)
         if len(shares) < self.device_combine_threshold:
             return pk_set.combine_signatures(shares)
         pts = [(i + 1, s.el) for i, s in sorted(shares.items())]
-        return Signature(self.group, self._lagrange_device_g2(pts))
+        self.counters.device_dispatches += 1
+        sig = Signature(self.group, self._lagrange_device_g2(pts))
+        if doc is not None:
+            # Defense in depth for the device ladder (see ops/curve.py
+            # docstring): one batched device pairing check of the combined
+            # signature against the master public key.  On mismatch fall
+            # back to the host golden combine — correctness over speed.
+            pk = pk_set.public_key()
+            ok = self._check_batch([(self.group.g1(), sig.el, pk.el, self._hash_g2(doc))])
+            if not ok[0]:
+                return pk_set.combine_signatures(shares)
+        return sig
 
     def combine_decryption_shares(
         self, pk_set: PublicKeySet, shares: Dict[int, DecryptionShare], ct: Ciphertext
@@ -446,9 +467,11 @@ class TpuBackend(CryptoBackend):
             raise CryptoError(
                 f"need {pk_set.threshold() + 1} shares, got {len(shares)}"
             )
+        self.counters.dec_shares_combined += len(shares)
         if len(shares) < self.device_combine_threshold:
             return pk_set.combine_decryption_shares(shares, ct)
         pts = [(i + 1, s.el) for i, s in sorted(shares.items())]
+        self.counters.device_dispatches += 1
         combined = self._lagrange_device_g1(pts)
         g = self.group
         pad = g.hash_bytes(g.g1_to_bytes(combined), len(ct.v))

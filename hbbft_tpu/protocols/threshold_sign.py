@@ -26,7 +26,7 @@ from hbbft_tpu.crypto.backend import CryptoBackend
 from hbbft_tpu.crypto.keys import Signature, SignatureShare
 
 
-@dataclass(frozen=True)
+@dataclass(frozen=True, slots=True)
 class ThresholdSignMessage:
     """Wire message: one node's signature share."""
 
@@ -130,7 +130,9 @@ class ThresholdSign(ConsensusProtocol):
         if self.signature is not None or len(self._verified) <= threshold:
             return Step()
         shares = dict(list(sorted(self._verified.items()))[: threshold + 1])
-        sig = self.backend.combine_signatures(self.netinfo.public_key_set, shares)
+        sig = self.backend.combine_signatures(
+            self.netinfo.public_key_set, shares, doc=self.doc
+        )
         self.signature = sig
         self._terminated = True
         return Step.from_output(sig)

@@ -65,6 +65,38 @@ def test_combine_signatures_device_and_host(backend, keyset, rng):
     assert pks.public_key().verify(sig_dev, doc)
 
 
+def test_combine_signatures_reverify_falls_back(backend, keyset, monkeypatch):
+    """A corrupted device combine must be caught by the master-PK re-verify
+    and replaced by the host golden combine (ops/curve.py defense-in-depth
+    claim)."""
+    sks, pks = keyset
+    doc = b"reverify-me"
+    shares = {i: sks.secret_key_share(i).sign_share(doc) for i in range(4)}
+    want = pks.combine_signatures(shares)
+
+    # Sabotage the device ladder: return a valid-looking but wrong G2 point.
+    wrong_point = backend.group.hash_to_g2(b"not the signature")
+    monkeypatch.setattr(
+        backend, "_lagrange_device_g2", lambda pts: wrong_point
+    )
+    backend.device_combine_threshold = 2
+    try:
+        sig = backend.combine_signatures(pks, shares, doc=doc)
+    finally:
+        backend.device_combine_threshold = 8
+    assert sig == want
+    assert pks.public_key().verify(sig, doc)
+
+    # Without the doc there is nothing to re-verify against: the corrupted
+    # point passes through (documents why callers should pass doc).
+    backend.device_combine_threshold = 2
+    try:
+        sig_noctx = backend.combine_signatures(pks, shares)
+    finally:
+        backend.device_combine_threshold = 8
+    assert sig_noctx.el == wrong_point
+
+
 def test_threshold_decryption_roundtrip(backend, keyset, rng):
     sks, pks = keyset
     msg = b"the quick brown badger"
