@@ -24,6 +24,7 @@ from hbbft_tpu.core.network_info import NetworkInfo
 from hbbft_tpu.core.types import CryptoWork, Step, TargetedMessage
 from hbbft_tpu.crypto.backend import CryptoBackend, MockBackend
 from hbbft_tpu.net.adversary import Adversary, NullAdversary
+from hbbft_tpu.utils.metrics import Counters, EventLog
 
 
 class CrankError(Exception):
@@ -63,6 +64,7 @@ class VirtualNet:
         crank_limit: Optional[int] = None,
         defer_mode: str = "eager",
         scheduler: str = "random",
+        event_log: Optional["EventLog"] = None,
     ) -> None:
         self.nodes = nodes
         self.backend = backend
@@ -79,6 +81,20 @@ class VirtualNet:
         self._sorted_ids = sorted(nodes)
         self._node_order = {n: i for i, n in enumerate(self._sorted_ids)}
         self._pending_work: List[CryptoWork] = []
+        #: net-side operative metrics; crypto-side live on backend.counters
+        self.counters = Counters()
+        #: opt-in structured per-crank trace (SURVEY.md §5 port note)
+        self.event_log = event_log
+
+    def metrics(self) -> Dict[str, int]:
+        """Combined net + crypto counters (one dict, SURVEY.md §5).
+
+        cranks/messages_delivered mirror the authoritative limit-check
+        attributes (single source of truth; the Counters copies are synced
+        here, not incremented separately)."""
+        self.counters.cranks = self.cranks
+        self.counters.messages_delivered = self.messages_delivered
+        return self.counters.merged_with(self.backend.counters)
 
     # -- introspection -------------------------------------------------------
 
@@ -132,6 +148,18 @@ class VirtualNet:
         if self.message_limit is not None and self.messages_delivered > self.message_limit:
             raise CrankError(f"message limit {self.message_limit} exceeded")
         step = node.algorithm.handle_message(msg.sender, msg.payload, rng=self.rng)
+        if self.event_log is not None:
+            self.event_log.emit(
+                event="crank",
+                crank=self.cranks,
+                sender=msg.sender,
+                to=msg.to,
+                msg_type=type(msg.payload).__name__,
+                outputs=len(step.output),
+                messages_out=len(step.messages),
+                faults=len(step.fault_log),
+                deferred=len(step.work),
+            )
         self._process_step(node, step)
         return msg.to, step
 
@@ -179,6 +207,13 @@ class VirtualNet:
     def _process_step(self, node: Node, step: Step) -> None:
         node.outputs.extend(step.output)
         node.faults_observed.extend(step.fault_log)
+        if step.fault_log.entries:
+            self.counters.faults_recorded += len(step.fault_log.entries)
+            if self.event_log is not None:
+                for f in step.fault_log.entries:
+                    self.event_log.emit(
+                        event="fault", observer=node.id, node=f.node_id, kind=f.kind
+                    )
         for work in step.work:
             if work.owner is None:
                 work.owner = node.id
@@ -255,6 +290,7 @@ class NetBuilder:
         self._crank_limit: Optional[int] = None
         self._defer_mode = "eager"
         self._scheduler = "random"
+        self._event_log: Optional[EventLog] = None
         self._constructor: Optional[Callable[[NetworkInfo, CryptoBackend], Any]] = None
 
     def num_faulty(self, f: int) -> "NetBuilder":
@@ -287,6 +323,11 @@ class NetBuilder:
     def scheduler(self, mode: str) -> "NetBuilder":
         assert mode in ("random", "first")
         self._scheduler = mode
+        return self
+
+    def trace(self, event_log: EventLog) -> "NetBuilder":
+        """Attach an opt-in structured per-crank event log."""
+        self._event_log = event_log
         return self
 
     def using(
@@ -336,4 +377,5 @@ class NetBuilder:
             crank_limit=self._crank_limit,
             defer_mode=self._defer_mode,
             scheduler=self._scheduler,
+            event_log=self._event_log,
         )

@@ -1,0 +1,88 @@
+"""Observability: counters + opt-in structured event log.
+
+SURVEY.md §5 (port note): the reference's only observability is `log` macros
+and the simulation's epoch table; the port is required to surface *counters*
+— messages, pairings verified, shares combined, epochs/sec — because they
+are literally the BASELINE metrics, plus a structured per-crank event log
+in the driver.
+
+:class:`Counters` is attached to every :class:`~hbbft_tpu.crypto.backend
+.CryptoBackend` (crypto-side tallies) and to :class:`~hbbft_tpu.net
+.virtual_net.VirtualNet` (net-side tallies).  :class:`EventLog` is opt-in
+(``NetBuilder.trace(...)``): when absent, the runtime pays one ``is None``
+check per crank.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import asdict, dataclass, field
+from typing import Any, Dict, List, Optional
+
+
+@dataclass(slots=True)
+class Counters:
+    """Monotonic tallies of the framework's operative metrics."""
+
+    # net-side
+    messages_delivered: int = 0
+    cranks: int = 0
+    faults_recorded: int = 0
+    # crypto-side: items verified per kind
+    sig_shares_verified: int = 0
+    dec_shares_verified: int = 0
+    signatures_verified: int = 0
+    ciphertexts_verified: int = 0
+    # crypto-side: how the work was done
+    pairing_checks: int = 0  # pairing-equation evaluations dispatched
+    rlc_groups: int = 0  # grouped (random-linear-combination) checks
+    sig_shares_combined: int = 0  # shares consumed by signature combines
+    dec_shares_combined: int = 0  # shares consumed by decryption combines
+    device_dispatches: int = 0  # jitted device calls issued
+
+    def snapshot(self) -> Dict[str, int]:
+        return asdict(self)
+
+    def diff(self, prev: Dict[str, int]) -> Dict[str, int]:
+        """Delta since a previous :meth:`snapshot` (only nonzero keys)."""
+        cur = self.snapshot()
+        return {k: cur[k] - prev.get(k, 0) for k in cur if cur[k] != prev.get(k, 0)}
+
+    def merged_with(self, other: "Counters") -> Dict[str, int]:
+        a, b = self.snapshot(), other.snapshot()
+        return {k: a[k] + b[k] for k in a}
+
+
+class EventLog:
+    """Opt-in structured per-crank event log (SURVEY.md §5 port note).
+
+    Events are plain dicts; ``emit`` is cheap append.  ``to_jsonl`` dumps
+    the log for offline analysis.  A ``capacity`` bound (default 1M) guards
+    against unbounded growth on soak runs — oldest events are dropped.
+    """
+
+    def __init__(self, capacity: int = 1_000_000) -> None:
+        self.capacity = capacity
+        self.events: List[Dict[str, Any]] = []
+        self._dropped = 0
+
+    def emit(self, **fields: Any) -> None:
+        if len(self.events) >= self.capacity:
+            del self.events[: self.capacity // 10]
+            self._dropped += self.capacity // 10
+        self.events.append(fields)
+
+    @property
+    def dropped(self) -> int:
+        return self._dropped
+
+    def __len__(self) -> int:
+        return len(self.events)
+
+    def of_type(self, event: str) -> List[Dict[str, Any]]:
+        return [e for e in self.events if e.get("event") == event]
+
+    def to_jsonl(self, path: str) -> None:
+        with open(path, "w") as f:
+            for e in self.events:
+                f.write(json.dumps(e, default=repr) + "\n")
