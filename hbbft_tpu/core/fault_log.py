@@ -17,7 +17,7 @@ from dataclasses import dataclass, field
 from typing import Any, Iterator, List
 
 
-@dataclass(frozen=True)
+@dataclass(frozen=True, slots=True)
 class Fault:
     """A single piece of evidence that ``node_id`` misbehaved."""
 
@@ -28,7 +28,7 @@ class Fault:
         return f"Fault({self.node_id!r}, {self.kind})"
 
 
-@dataclass
+@dataclass(slots=True)
 class FaultLog:
     """An append-only list of :class:`Fault` entries."""
 

@@ -18,6 +18,7 @@ Design deltas vs the reference (deliberate, TPU-first):
 from __future__ import annotations
 
 import dataclasses
+import functools
 from dataclasses import dataclass, field
 from typing import Any, Callable, Generic, Hashable, Iterable, Optional, TypeVar
 
@@ -33,7 +34,7 @@ M = TypeVar("M")  # message payload type
 # ---------------------------------------------------------------------------
 
 
-@dataclass(frozen=True)
+@dataclass(frozen=True, slots=True)
 class Target(Generic[NodeId]):
     """Routing directive for an outgoing message.
 
@@ -50,11 +51,16 @@ class Target(Generic[NodeId]):
 
     @staticmethod
     def all() -> "Target":
-        return Target("all")
+        return _TARGET_ALL
 
     @staticmethod
     def node(node_id) -> "Target":
-        return Target("node", frozenset([node_id]))
+        # Memoized: Target.node(peer) is built once per message *delivery*
+        # (hot in SenderQueue routing); targets are frozen so sharing is safe.
+        try:
+            return _node_target(node_id)
+        except TypeError:  # unhashable id — cannot memoize
+            return Target("node", frozenset([node_id]))
 
     @staticmethod
     def nodes(node_ids: Iterable) -> "Target":
@@ -81,7 +87,15 @@ class Target(Generic[NodeId]):
         return node_id not in self.ids and node_id != our_id
 
 
-@dataclass(frozen=True)
+_TARGET_ALL = Target("all")
+
+
+@functools.lru_cache(maxsize=4096)
+def _node_target(node_id) -> "Target":
+    return Target("node", frozenset([node_id]))
+
+
+@dataclass(frozen=True, slots=True)
 class TargetedMessage(Generic[M, NodeId]):
     """An outgoing message with its routing target (hbbft `TargetedMessage` §)."""
 
@@ -92,7 +106,7 @@ class TargetedMessage(Generic[M, NodeId]):
         return TargetedMessage(self.target, f(self.message))
 
 
-@dataclass(frozen=True)
+@dataclass(frozen=True, slots=True)
 class SourcedMessage(Generic[M, NodeId]):
     """An inbound message tagged with its sender (hbbft `SourcedMessage` §)."""
 
@@ -105,7 +119,7 @@ class SourcedMessage(Generic[M, NodeId]):
 # ---------------------------------------------------------------------------
 
 
-@dataclass
+@dataclass(slots=True)
 class CryptoWork:
     """A crypto check/combine deferred to the round-barrier device batch.
 
@@ -126,7 +140,7 @@ class CryptoWork:
 # ---------------------------------------------------------------------------
 
 
-@dataclass
+@dataclass(slots=True)
 class Step(Generic[NodeId]):
     """Result of one state-machine transition (hbbft `Step` §).
 
@@ -203,7 +217,11 @@ class Step(Generic[NodeId]):
         return self
 
     def __bool__(self) -> bool:
-        return bool(self.output or self.messages or self.fault_log or self.work)
+        # Hot (hundreds of thousands of calls per simulated epoch): read
+        # fault_log.entries directly to skip a FaultLog.__bool__ dispatch.
+        return bool(
+            self.messages or self.output or self.work or self.fault_log.entries
+        )
 
 
 def absorb_child_step(
@@ -223,10 +241,9 @@ def absorb_child_step(
     ``wrap_msg``  — child message -> parent message envelope.
     ``on_output`` — child output -> parent Step (parent's reaction).
     """
-    step = Step()
     if not child_step:
-        return step
-    step.messages.extend(tm.map(wrap_msg) for tm in child_step.messages)
+        return Step()
+    step = Step(messages=[tm.map(wrap_msg) for tm in child_step.messages])
     step.fault_log.extend(child_step.fault_log)
     for work in child_step.work:
         step.work.append(
@@ -246,13 +263,7 @@ def absorb_child_step(
     return step
 
 
-# ---------------------------------------------------------------------------
-# Epoched — protocols whose messages carry an epoch (hbbft `Epoched` trait §).
-# ---------------------------------------------------------------------------
-
-
-class Epoched:
-    """Mixin marking message types that carry an epoch/era coordinate."""
-
-    def epoch(self):  # pragma: no cover - interface
-        raise NotImplementedError
+# The reference's `Epoched` trait (SURVEY.md §2.1) has no class here: epoch
+# extraction is structural — SenderQueue reads the epoch coordinate off
+# message dataclasses directly (sender_queue._default_msg_epoch), which is
+# the idiomatic-Python equivalent of the trait bound.

@@ -37,7 +37,7 @@ from hbbft_tpu.utils.canonical import encode as canonical_encode
 MAX_FUTURE_ROUNDS = 1000
 
 
-@dataclass(frozen=True)
+@dataclass(frozen=True, slots=True)
 class BaMessage:
     """Round-tagged BA wire message.
 

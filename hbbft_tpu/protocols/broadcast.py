@@ -29,7 +29,7 @@ from hbbft_tpu.crypto.erasure import RSCodec, rs_codec
 from hbbft_tpu.crypto.merkle import MerkleTree, Proof
 
 
-@dataclass(frozen=True)
+@dataclass(frozen=True, slots=True)
 class BroadcastMessage:
     """kind ∈ {"value", "echo", "ready"}; payload: Proof | Proof | root bytes."""
 

@@ -15,7 +15,7 @@ from typing import Any, Optional, Tuple
 from hbbft_tpu.protocols.honey_badger import EncryptionSchedule
 
 
-@dataclass(frozen=True)
+@dataclass(frozen=True, slots=True)
 class Change:
     """kind ∈ {"add", "remove", "schedule"}."""
 
@@ -65,7 +65,7 @@ class Change:
         raise ValueError(f"unknown change kind {t[0]!r}")
 
 
-@dataclass(frozen=True)
+@dataclass(frozen=True, slots=True)
 class ChangeState:
     """kind ∈ {"none", "in_progress", "complete"}."""
 

@@ -49,13 +49,13 @@ from hbbft_tpu.protocols.votes import SignedVote, VoteCounter
 from hbbft_tpu.utils import canonical
 
 
-@dataclass(frozen=True)
+@dataclass(frozen=True, slots=True)
 class DhbMessage:
     era: int
     payload: Any  # HbMessage
 
 
-@dataclass
+@dataclass(slots=True)
 class DhbBatch:
     """One committed epoch: user contributions + membership-change state."""
 
@@ -73,7 +73,7 @@ class DhbBatch:
         )
 
 
-@dataclass(frozen=True)
+@dataclass(frozen=True, slots=True)
 class JoinPlan:
     """Everything a joining observer needs to follow era ``era``
     (reference `JoinPlan` §)."""

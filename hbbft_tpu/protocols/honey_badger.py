@@ -39,7 +39,7 @@ from hbbft_tpu.utils import canonical
 # ---------------------------------------------------------------------------
 
 
-@dataclass(frozen=True)
+@dataclass(frozen=True, slots=True)
 class EncryptionSchedule:
     """When to threshold-encrypt contributions.
 
@@ -84,7 +84,7 @@ class EncryptionSchedule:
 # ---------------------------------------------------------------------------
 
 
-@dataclass
+@dataclass(slots=True)
 class Batch:
     epoch: int
     contributions: Dict[Any, Any]
@@ -100,7 +100,7 @@ class Batch:
         )
 
 
-@dataclass(frozen=True)
+@dataclass(frozen=True, slots=True)
 class HbMessage:
     """kind ∈ {"subset", "dec_share"}; epoch-tagged envelope."""
 

@@ -24,7 +24,7 @@ from hbbft_tpu.core.types import Step, Target, TargetedMessage
 from hbbft_tpu.protocols.bool_set import BoolMultimap, BoolSet
 
 
-@dataclass(frozen=True)
+@dataclass(frozen=True, slots=True)
 class SbvMessage:
     kind: str  # "bval" | "aux"
     value: bool

@@ -20,12 +20,18 @@ from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from hbbft_tpu.core.protocol import ConsensusProtocol
-from hbbft_tpu.core.types import Step, Target, TargetedMessage, absorb_child_step
+from hbbft_tpu.core.types import (
+    CryptoWork,
+    Step,
+    Target,
+    TargetedMessage,
+    absorb_child_step,
+)
 from hbbft_tpu.protocols.dynamic_honey_badger import DhbMessage
 from hbbft_tpu.protocols.honey_badger import HbMessage
 
 
-@dataclass(frozen=True)
+@dataclass(frozen=True, slots=True)
 class SqMessage:
     """kind ∈ {"epoch_started", "algo"}."""
 
@@ -75,16 +81,31 @@ class SenderQueue(ConsensusProtocol):
         self.peer_epochs: Dict[Any, Tuple[int, int]] = {}
         self._outgoing: Dict[Any, List[Any]] = {}  # peer -> buffered inner msgs
         self._last_announced: Optional[Tuple[int, int]] = None
+        # peers() memo: invalidated when the era's NetworkInfo object is
+        # replaced or a peer set grows (both sets only ever grow).
+        self._peers_cache: Optional[List[Any]] = None
+        self._peers_netinfo: Any = None
+        self._peers_sizes: Tuple[int, int] = (-1, -1)
 
     # -- peers ---------------------------------------------------------------
 
     def peers(self) -> List[Any]:
         netinfo = getattr(self.algo, "netinfo", None)
+        sizes = (len(self._extra_peers), len(self.peer_epochs))
+        if (
+            self._peers_cache is not None
+            and self._peers_netinfo is netinfo
+            and self._peers_sizes == sizes
+        ):
+            return self._peers_cache
         ids = set(netinfo.all_ids()) if netinfo is not None else set()
         ids |= self._extra_peers
         ids |= set(self.peer_epochs)
         ids.discard(self.our_id())
-        return sorted(ids, key=repr)
+        self._peers_cache = sorted(ids, key=repr)
+        self._peers_netinfo = netinfo  # strong ref: no id-reuse staleness
+        self._peers_sizes = sizes
+        return self._peers_cache
 
     def add_peer(self, node_id) -> None:
         """Register an observer so it receives algorithm traffic."""
@@ -156,13 +177,19 @@ class SenderQueue(ConsensusProtocol):
         self._outgoing[peer] = keep
         return step
 
-    def _classify(self, peer, msg) -> str:
+    def _classify(self, peer, msg, era_epoch=None) -> str:
+        """The single epoch-gating predicate (both the hot `_post` loop and
+        buffered replay route through here).  ``era_epoch`` lets callers
+        pass a precomputed ``msg_epoch_fn(msg)`` to avoid re-extracting it
+        once per peer."""
         peer_epoch = self.peer_epochs.get(peer)
         if peer_epoch is None:
             # Unknown progress: optimistic send (the peer buffers future
             # epochs itself, same as an un-wrapped network).
             return "send"
-        era, epoch = self.msg_epoch_fn(msg)
+        era, epoch = (
+            era_epoch if era_epoch is not None else self.msg_epoch_fn(msg)
+        )
         p_era, p_epoch = peer_epoch
         if era < p_era or (era == p_era and epoch < p_epoch):
             return "obsolete"
@@ -173,8 +200,6 @@ class SenderQueue(ConsensusProtocol):
     # -- outgoing interception ----------------------------------------------
 
     def _post(self, inner_step: Step) -> Step:
-        from hbbft_tpu.core.types import CryptoWork
-
         routed = Step(output=list(inner_step.output))
         routed.fault_log.extend(inner_step.fault_log)
         # Deferred-crypto follow-up steps must re-enter through _post so
@@ -188,11 +213,31 @@ class SenderQueue(ConsensusProtocol):
                     owner=w.owner,
                 )
             )
+        # Inline per-peer routing (the N·messages hot loop): the envelope is
+        # built once per message (frozen — shared across peers) and the
+        # message's epoch is extracted lazily, once, not once per peer.
+        msgs = routed.messages
+        peers = self.peers()
+        our = self.our_id()
+        peer_epochs = self.peer_epochs
         for tm in inner_step.messages:
-            routed.extend(self._route(tm))
+            m = tm.message
+            envelope = SqMessage.algo(m)
+            era_epoch = None
+            for peer in tm.target.recipients(peers, our_id=our):
+                if era_epoch is None and peer_epochs.get(peer) is not None:
+                    era_epoch = self.msg_epoch_fn(m)
+                status = self._classify(peer, m, era_epoch)
+                if status == "send":
+                    msgs.append(TargetedMessage(Target.node(peer), envelope))
+                elif status == "premature":
+                    self._outgoing.setdefault(peer, []).append(m)
+                # obsolete: drop
         return routed.extend(self._maybe_announce())
 
     def _route(self, tm: TargetedMessage) -> Step:
+        """Route one targeted message (the unit-testable single-message
+        form of the inlined loop in :meth:`_post`)."""
         step = Step()
         for peer in tm.target.recipients(self.peers(), our_id=self.our_id()):
             status = self._classify(peer, tm.message)

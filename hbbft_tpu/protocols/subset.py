@@ -35,7 +35,7 @@ from hbbft_tpu.protocols.broadcast import Broadcast
 from hbbft_tpu.utils.canonical import encode as canonical_encode
 
 
-@dataclass(frozen=True)
+@dataclass(frozen=True, slots=True)
 class SubsetMessage:
     """kind ∈ {"broadcast", "agreement"}; routed to the child for ``proposer``."""
 
@@ -44,7 +44,7 @@ class SubsetMessage:
     payload: Any
 
 
-@dataclass(frozen=True)
+@dataclass(frozen=True, slots=True)
 class SubsetOutput:
     """Either one accepted contribution or the final Done marker."""
 

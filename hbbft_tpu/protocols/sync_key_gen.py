@@ -35,7 +35,7 @@ from hbbft_tpu.crypto.poly import BivarCommitment, BivarPoly, Commitment, Poly
 from hbbft_tpu.utils import canonical
 
 
-@dataclass(frozen=True)
+@dataclass(frozen=True, slots=True)
 class Part:
     """A proposer's commitment + per-node encrypted rows."""
 
@@ -50,7 +50,7 @@ class Part:
         )
 
 
-@dataclass(frozen=True)
+@dataclass(frozen=True, slots=True)
 class Ack:
     """An acker's per-node encrypted values for one proposer's part."""
 
@@ -81,13 +81,13 @@ def ack_from_canonical(t) -> Ack:
     return Ack(proposer_idx, tuple(values))
 
 
-@dataclass
+@dataclass(slots=True)
 class PartOutcome:
     ack: Optional[Ack] = None
     fault: Optional[str] = None
 
 
-@dataclass
+@dataclass(slots=True)
 class AckOutcome:
     fault: Optional[str] = None
 

@@ -23,7 +23,7 @@ from hbbft_tpu.crypto.backend import CryptoBackend
 from hbbft_tpu.crypto.keys import Ciphertext, DecryptionShare
 
 
-@dataclass(frozen=True)
+@dataclass(frozen=True, slots=True)
 class ThresholdDecryptMessage:
     share: DecryptionShare
 

@@ -17,7 +17,7 @@ from hbbft_tpu.protocols.change import Change
 from hbbft_tpu.utils import canonical
 
 
-@dataclass(frozen=True)
+@dataclass(frozen=True, slots=True)
 class SignedVote:
     voter: Any
     era: int
