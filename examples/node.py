@@ -35,6 +35,11 @@ BASE_PORT = 42_000
 
 
 def encode_frame(obj: Any) -> bytes:
+    # DEMO-ONLY WIRE FORMAT: pickle is convenient for arbitrary message
+    # dataclasses but `pickle.loads` on network input is arbitrary code
+    # execution — anything that can reach the localhost port owns this
+    # process.  A real embedder must use the deterministic TLV encoding in
+    # hbbft_tpu/utils/canonical.py (the bincode-equivalent wire discipline).
     payload = pickle.dumps(obj, protocol=4)
     return len(payload).to_bytes(4, "big") + payload
 
@@ -42,7 +47,7 @@ def encode_frame(obj: Any) -> bytes:
 async def read_frame(reader: asyncio.StreamReader) -> Any:
     header = await reader.readexactly(4)
     payload = await reader.readexactly(int.from_bytes(header, "big"))
-    return pickle.loads(payload)
+    return pickle.loads(payload)  # see encode_frame: demo-only, code-exec-trusting
 
 
 class PeerNode:

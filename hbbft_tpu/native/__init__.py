@@ -11,7 +11,9 @@ back to the numpy implementations transparently.
 from __future__ import annotations
 
 import ctypes
+import hashlib
 import os
+import platform
 import subprocess
 import tempfile
 from typing import Optional
@@ -20,7 +22,23 @@ import numpy as np
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_DIR, "gf256_kernel.c")
-_SO = os.path.join(_DIR, "_gf256_kernel.so")
+
+
+def _host_tag() -> str:
+    """ISA fingerprint for the .so cache name: a -march=native object built
+    on one machine must not be loaded on another (SIGILL on a checkout
+    shared over NFS or baked into a reused container image)."""
+    feat = ""
+    try:
+        with open("/proc/cpuinfo") as f:
+            feat = next((ln for ln in f if ln.startswith(("flags", "Features"))), "")
+    except OSError:
+        pass
+    digest = hashlib.sha256((platform.machine() + feat).encode()).hexdigest()[:12]
+    return f"{platform.machine()}-{digest}"
+
+
+_SO = os.path.join(_DIR, f"_gf256_kernel.{_host_tag()}.so")
 
 _lib: Optional[ctypes.CDLL] = None
 _tried = False
