@@ -112,3 +112,52 @@ def test_batch_inv_fq2(rng):
     got = tower.batch_inv_fq2(a)
     for i in range(9):
         assert tower.fq2_to_ints(got, i) == gold.fq2_inv(xs[i])
+
+
+def _rand_cyclotomic(rng):
+    """Random element of the Φ₁₂ cyclotomic subgroup via the easy part."""
+    f = rnd_fq12(rng)
+    t = gold.fq12_mul(gold.fq12_conj(f), gold.fq12_inv(f))
+    return gold.fq12_mul(gold.fq12_pow(t, Q * Q), t)
+
+
+def test_fq12_cyclo_sqr(rng):
+    cycs = [_rand_cyclotomic(rng) for _ in range(3)]
+    dev = tower.fq12_stack(cycs)
+    out = tower.fq12_cyclo_sqr(dev)
+    for i, c in enumerate(cycs):
+        assert tower.fq12_to_ints(out, i) == gold.fq12_sqr(c)
+
+
+def test_fq12_cyclo_sqr_chained(rng):
+    """64 chained squarings (the x-chain depth) stay exact — guards the
+    limb renormalization against envelope overflow."""
+    c = _rand_cyclotomic(rng)
+    cur = tower.fq12_stack([c])
+    for _ in range(64):
+        cur = tower.fq12_cyclo_sqr(cur)
+    assert tower.fq12_to_ints(cur, 0) == gold.fq12_pow(c, 1 << 64)
+
+
+def test_fq12_cyclo_pow_segmented(rng):
+    from hbbft_tpu.crypto.bls381 import BLS_X
+
+    cycs = [_rand_cyclotomic(rng) for _ in range(2)]
+    dev = tower.fq12_stack(cycs)
+    for e in (BLS_X, 5, 1, 0b1000001):
+        out = tower.fq12_cyclo_pow_segmented(dev, e)
+        for i, c in enumerate(cycs):
+            assert tower.fq12_to_ints(out, i) == gold.fq12_pow(c, e)
+
+
+def test_fq12_mul_line(rng):
+    zero2 = (0, 0)
+    fs = [rnd_fq12(rng) for _ in range(3)]
+    lines = [[rnd_fq2(rng) for _ in range(3)] for _ in range(3)]
+    fdev = tower.fq12_stack(fs)
+    ldev = tuple(tower.fq2_stack([l[k] for l in lines]) for k in range(3))
+    out = tower.fq12_mul_line(fdev, ldev)
+    for i in range(3):
+        l0, l4, l5 = lines[i]
+        sparse = ((l0, zero2, zero2), (zero2, l4, l5))
+        assert tower.fq12_to_ints(out, i) == gold.fq12_mul(fs[i], sparse)

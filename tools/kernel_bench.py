@@ -1,0 +1,110 @@
+"""Microbenchmark: raw Fq limb-multiply throughput on the current backend.
+
+Timing methodology (important): the tunneled TPU platform ("axon") both
+memoizes identical dispatches AND returns from ``block_until_ready``
+before the computation has really finished, so naive timing reports
+physically impossible numbers (hundreds of Tflop/s).  The only reliable
+sync is a host transfer.  Every measurement here therefore (a) chains N
+data-dependent multiplies inside one jitted scan so the work cannot be
+elided or overlapped, (b) uses fresh input buffers per call, and (c)
+fetches one element to host as the fence.  The scan makes the fetch
+round-trip amortize to latency/N per multiply.
+
+    python tools/kernel_bench.py
+    HBBFT_TPU_CONV_MODE=concat python tools/kernel_bench.py
+    HBBFT_TPU_NO_PALLAS=1 python tools/kernel_bench.py
+"""
+
+import functools
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from hbbft_tpu.ops import fq
+
+CHAIN = 400  # data-dependent muls per timed dispatch
+
+
+@functools.partial(jax.jit, static_argnums=2)
+def _mul_chain(a, b, n):
+    def step(x, _):
+        return fq.mul(x, b), None
+    out, _ = jax.lax.scan(step, a, None, length=n)
+    return out
+
+
+def _rand_limbs(rng, lanes):
+    return jnp.asarray(
+        rng.integers(0, fq.BASE, size=(lanes, fq.NLIMBS)).astype(fq.NP_DTYPE)
+    )
+
+
+def _fence(x):
+    """Host-fetch fence: returns only when the device really finished."""
+    return np.asarray(x[0, :1])
+
+
+def measure_mul(rng, lanes, reps=2):
+    b = _rand_limbs(rng, lanes)
+    _fence(_mul_chain(_rand_limbs(rng, lanes), b, CHAIN))  # compile+warm
+    best = float("inf")
+    for _ in range(reps):
+        a = _rand_limbs(rng, lanes)
+        _fence(a)  # materialize input before timing
+        t0 = time.perf_counter()
+        _fence(_mul_chain(a, b, CHAIN))
+        best = min(best, (time.perf_counter() - t0) / CHAIN)
+    return best
+
+
+def main():
+    rng = np.random.default_rng(0)
+    print(
+        f"backend={jax.default_backend()} BITS={fq.BITS} "
+        f"conv_mode={os.environ.get('HBBFT_TPU_CONV_MODE', 'scratch')} "
+        f"no_pallas={bool(os.environ.get('HBBFT_TPU_NO_PALLAS'))}"
+    )
+    for lanes in (4096, 16384, 65536, 262144):
+        dt = measure_mul(rng, lanes)
+        print(
+            f"lanes={lanes:7d}  fq.mul: {dt*1e3:8.4f} ms  "
+            f"{lanes/dt/1e6:8.2f} M muls/s"
+        )
+
+    # VPU roofline probe: same chain+fence discipline, pure FMA body.
+    lanes = 262144
+    rows = 50
+    y = jnp.asarray(rng.random((rows, lanes)), jnp.float32)
+
+    @functools.partial(jax.jit, static_argnums=1)
+    def fma_chain(x, n):
+        def step(acc, _):
+            # 50 dependent FMAs over a (50, lanes) tile ~ one conv's flops
+            for _ in range(rows):
+                acc = acc * 1.0000001 + y
+            return acc, None
+        out, _ = jax.lax.scan(step, x, None, length=n)
+        return out
+
+    x = jnp.asarray(rng.random((rows, lanes)), jnp.float32)
+    _ = np.asarray(fma_chain(x, 50)[0, :1])
+    t0 = time.perf_counter()
+    _ = np.asarray(fma_chain(x + 1.0, 50)[0, :1])
+    dt = (time.perf_counter() - t0) / 50
+    flops = 2 * rows * rows * lanes
+    print(
+        f"VPU FMA roofline probe: {dt*1e3:.4f} ms/step  "
+        f"{flops/dt/1e12:.3f} Tflop/s "
+        f"(= {flops/2/2500/dt/1e6:.1f} M conv-equiv muls/s)"
+    )
+
+
+if __name__ == "__main__":
+    main()
