@@ -11,6 +11,7 @@ Reference analogue: the `ff`/`pairing` field arithmetic underneath the
 
 from __future__ import annotations
 
+import functools
 from typing import Dict, Iterable, List, Sequence, Tuple
 
 # BLS12-381 base-field modulus (Fq) and subgroup order (Fr).
@@ -35,7 +36,16 @@ def lagrange_coeffs_at_zero(xs: Sequence[int], modulus: int = R) -> List[int]:
     This is the share-combination kernel: combining signature/decryption
     shares is exactly this sum computed "in the exponent"
     (threshold_crypto `combine_signatures` §).
+
+    Memoized: every epoch combines thousands of share sets over the SAME
+    x-coordinates (the lowest f+1 verified indices), and the coefficients
+    are public constants of those coordinates.
     """
+    return list(_lagrange_cached(tuple(xs), modulus))
+
+
+@functools.lru_cache(maxsize=4096)
+def _lagrange_cached(xs: tuple, modulus: int) -> tuple:
     xs = [x % modulus for x in xs]
     if len(set(xs)) != len(xs):
         raise ValueError("interpolation points must be distinct")
@@ -48,7 +58,7 @@ def lagrange_coeffs_at_zero(xs: Sequence[int], modulus: int = R) -> List[int]:
             num = (num * xk) % modulus
             den = (den * (xk - xj)) % modulus
         coeffs.append((num * modinv(den, modulus)) % modulus)
-    return coeffs
+    return tuple(coeffs)
 
 
 def interpolate_at_zero(points: Iterable[Tuple[int, int]], modulus: int = R) -> int:

@@ -157,8 +157,16 @@ class Ciphertext:
         return Ciphertext(g, u, v, w)
 
     def hash_point(self) -> Any:
-        """H2(U‖V) — the G2 point both validity checks pair against."""
-        return self.G.hash_to_g2(self.G.g1_to_bytes(self.u) + self.v)
+        """H2(U‖V) — the G2 point both validity checks pair against.
+
+        Memoized per instance: verification of every share of this
+        ciphertext pairs against the same point, and the batch paths
+        (backend verify, array engine) hit it O(N²) times."""
+        cached = getattr(self, "_hash_point", None)
+        if cached is None:
+            cached = self.G.hash_to_g2(self.G.g1_to_bytes(self.u) + self.v)
+            self._hash_point = cached
+        return cached
 
     def verify(self) -> bool:
         g = self.G

@@ -130,3 +130,65 @@ class MerkleTree:
             root_hash=self.root_hash,
             n_leaves=len(self.leaves),
         )
+
+
+def validate_proofs(proofs: Sequence[Proof], n_leaves: int, reps: int = 1) -> List[bool]:
+    """Batched proof validation: the array engine's hash entry point.
+
+    Validates each distinct proof ``reps`` times (N receivers each check
+    the same honest echo — the repetition keeps the measured hash workload
+    equal to N independent nodes without materializing N× Python objects).
+    Returns one bool per distinct proof (identical across repetitions).
+
+    Dispatches to the C SHA-NI batch kernel (hbbft_tpu/native) when
+    available, falling back to the hashlib loop.  Proofs are grouped by
+    (value length, path depth) so each group packs into rectangular
+    arrays; structural checks (leaf count, index range, depth) mirror
+    Proof.validate and fail fast without hashing.
+    """
+    import numpy as np
+
+    from hbbft_tpu import native
+
+    out = [False] * len(proofs)
+    depth = _depth(n_leaves)
+    groups: dict = {}
+    for i, p in enumerate(proofs):
+        if (
+            p.n_leaves != n_leaves
+            or not 0 <= p.index < n_leaves
+            or len(p.path) != depth
+            or len(p.root_hash) != 32
+            or any(len(s) != 32 for s in p.path)
+        ):
+            continue  # structurally invalid: stays False, no hashing
+        groups.setdefault(len(p.value), []).append(i)
+
+    for leaf_len, idxs in groups.items():
+        sub = [proofs[i] for i in idxs]
+        ok = None
+        if native.sha256_available() and leaf_len + 1 <= 4096:
+            lv = np.frombuffer(
+                b"".join(p.value for p in sub), dtype=np.uint8
+            ).reshape(len(sub), leaf_len)
+            if depth:
+                paths = np.frombuffer(
+                    b"".join(b"".join(p.path) for p in sub), dtype=np.uint8
+                ).reshape(len(sub), depth, 32)
+            else:
+                paths = np.zeros((len(sub), 0, 32), dtype=np.uint8)
+            indices = np.array([p.index for p in sub], dtype=np.int32)
+            roots = np.frombuffer(
+                b"".join(p.root_hash for p in sub), dtype=np.uint8
+            ).reshape(len(sub), 32)
+            ok = native.merkle_validate_batch(lv, paths, indices, roots, reps)
+        if ok is None:  # hashlib fallback
+            ok = []
+            for p in sub:
+                good = True
+                for _ in range(reps):
+                    good = p.validate(n_leaves)
+                ok.append(good)
+        for i, good in zip(idxs, ok):
+            out[i] = bool(good)
+    return out

@@ -44,23 +44,21 @@ _lib: Optional[ctypes.CDLL] = None
 _tried = False
 
 
-def _build() -> bool:
-    """(Re)build the shared object if missing or stale.  Returns success."""
+def _build_so(src: str, so: str) -> bool:
+    """(Re)build a shared object if missing or stale.  Returns success."""
     try:
-        if os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
+        if os.path.exists(so) and os.path.getmtime(so) >= os.path.getmtime(src):
             return True
         # Unique temp output per process so concurrent builders can't
         # publish each other's half-written object; os.replace is atomic.
-        tmp = f"{_SO}.{os.getpid()}.tmp"
+        tmp = f"{so}.{os.getpid()}.tmp"
         for flags in (["-march=native"], []):  # fall back if -march trips
-            cmd = (
-                ["cc", "-O3", "-shared", "-fPIC"] + flags + ["-o", tmp, _SRC]
-            )
+            cmd = ["cc", "-O3", "-shared", "-fPIC"] + flags + ["-o", tmp, src]
             try:
                 subprocess.run(
                     cmd, check=True, capture_output=True, timeout=120
                 )
-                os.replace(tmp, _SO)
+                os.replace(tmp, so)
                 return True
             except (subprocess.CalledProcessError, subprocess.TimeoutExpired):
                 continue
@@ -73,6 +71,10 @@ def _build() -> bool:
         return False
     except OSError:
         return False
+
+
+def _build() -> bool:
+    return _build_so(_SRC, _SO)
 
 
 def _load() -> Optional[ctypes.CDLL]:
@@ -120,4 +122,118 @@ def gf256_matmul(m: np.ndarray, x: np.ndarray) -> Optional[np.ndarray]:
         raise ValueError("shape mismatch")
     out = np.empty((r, L), dtype=np.uint8)
     lib.gf256_matmul(m, x, out, r, k, L)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# SHA-256 / Merkle batch kernel (sha256_kernel.c)
+# ---------------------------------------------------------------------------
+
+_SHA_SRC = os.path.join(_DIR, "sha256_kernel.c")
+_SHA_SO = os.path.join(_DIR, f"_sha256_kernel.{_host_tag()}.so")
+_sha_lib: Optional[ctypes.CDLL] = None
+_sha_tried = False
+
+
+def _load_sha() -> Optional[ctypes.CDLL]:
+    global _sha_lib, _sha_tried
+    if _sha_lib is not None or _sha_tried:
+        return _sha_lib
+    _sha_tried = True
+    if not _build_so(_SHA_SRC, _SHA_SO):
+        return None
+    try:
+        lib = ctypes.CDLL(_SHA_SO)
+    except OSError:
+        return None
+    u8p = np.ctypeslib.ndpointer(dtype=np.uint8, flags="C_CONTIGUOUS")
+    i32p = np.ctypeslib.ndpointer(dtype=np.int32, flags="C_CONTIGUOUS")
+    lib.sha256_batch.argtypes = [u8p, ctypes.c_long, ctypes.c_long, u8p]
+    lib.sha256_batch.restype = None
+    lib.merkle_validate_batch.argtypes = [
+        u8p, ctypes.c_long, u8p, i32p, u8p,
+        ctypes.c_long, ctypes.c_long, ctypes.c_long, u8p,
+    ]
+    lib.merkle_validate_batch.restype = None
+    lib.merkle_root_batch.argtypes = [
+        u8p, ctypes.c_long, ctypes.c_long, ctypes.c_long,
+        ctypes.c_long, ctypes.c_long, u8p,
+    ]
+    lib.merkle_root_batch.restype = None
+
+    # Self-test against hashlib — guards the SHA-NI block schedule (and
+    # falls back to the scalar path, then to hashlib, on any mismatch).
+    probe = np.frombuffer(b"abc" + bytes(61), dtype=np.uint8).reshape(1, 64)
+    out = np.empty((1, 32), dtype=np.uint8)
+    lib.sha256_batch(np.ascontiguousarray(probe), 1, 64, out)
+    if out.tobytes() != hashlib.sha256(probe.tobytes()).digest():
+        try:
+            lib.sha256_disable_ni()
+            lib.sha256_batch(np.ascontiguousarray(probe), 1, 64, out)
+            if out.tobytes() != hashlib.sha256(probe.tobytes()).digest():
+                return None
+        except Exception:
+            return None
+    _sha_lib = lib
+    return _sha_lib
+
+
+def sha256_available() -> bool:
+    return _load_sha() is not None
+
+
+def sha256_batch(data: np.ndarray) -> Optional[np.ndarray]:
+    """Hash each row of a (n, item_len) uint8 array; None if no C kernel."""
+    lib = _load_sha()
+    if lib is None:
+        return None
+    data = np.ascontiguousarray(data, dtype=np.uint8)
+    n, item_len = data.shape
+    out = np.empty((n, 32), dtype=np.uint8)
+    lib.sha256_batch(data, n, item_len, out)
+    return out
+
+
+def merkle_validate_batch(
+    leaf_vals: np.ndarray,
+    paths: np.ndarray,
+    indices: np.ndarray,
+    roots: np.ndarray,
+    reps: int,
+) -> Optional[np.ndarray]:
+    """Validate n proofs (each reps times).  Shapes: leaf_vals (n, L),
+    paths (n, depth, 32), indices (n,), roots (n, 32).  Returns (n,) bool
+    or None if the C kernel is unavailable or L is out of contract."""
+    lib = _load_sha()
+    if lib is None:
+        return None
+    leaf_vals = np.ascontiguousarray(leaf_vals, dtype=np.uint8)
+    n, leaf_len = leaf_vals.shape
+    if leaf_len + 1 > 4096:
+        return None  # h_leaf buffer contract in sha256_kernel.c
+    paths = np.ascontiguousarray(paths, dtype=np.uint8)
+    indices = np.ascontiguousarray(indices, dtype=np.int32)
+    roots = np.ascontiguousarray(roots, dtype=np.uint8)
+    depth = paths.shape[1] if paths.ndim == 3 else 0
+    ok = np.empty(n, dtype=np.uint8)
+    lib.merkle_validate_batch(
+        leaf_vals, leaf_len, paths, indices, roots, n, depth, int(reps), ok
+    )
+    return ok.astype(bool)
+
+
+def merkle_root_batch(
+    leaves: np.ndarray, size: int, reps: int
+) -> Optional[np.ndarray]:
+    """Roots of t trees: leaves (t, n_leaves, leaf_len), padded to `size`
+    (pow2 ≤ 256) with empty leaves; each built reps times.  (t, 32) out."""
+    lib = _load_sha()
+    if lib is None:
+        return None
+    leaves = np.ascontiguousarray(leaves, dtype=np.uint8)
+    t, n_leaves, leaf_len = leaves.shape
+    if size > 256 or leaf_len + 1 > 4096:
+        return None
+    out = np.empty((t, 32), dtype=np.uint8)
+    lib.merkle_root_batch(leaves, t, n_leaves, leaf_len, size, int(reps), out)
     return out

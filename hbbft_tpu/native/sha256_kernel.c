@@ -1,0 +1,261 @@
+/* Batched SHA-256 host kernel for the Merkle hot loop.
+ *
+ * The array engine validates O(N^3) Merkle proofs per epoch (SURVEY.md
+ * par.3.2 marks the Echo verifies HOT at N=100); via hashlib each digest
+ * costs ~1us of Python overhead regardless of openssl speed.  This kernel
+ * runs whole proof batches per call: leaf hash -> path fold -> root
+ * compare, entirely in C.  FIPS 180-4 SHA-256, written out from the spec;
+ * a SHA-NI block function is used when the toolchain/CPU support it
+ * (guarded by a loader self-test, scalar otherwise).
+ *
+ * Domain separation matches crypto/merkle.py: leaf = H(0x00||data),
+ * node = H(0x01||left||right).
+ */
+
+#include <stdint.h>
+#include <string.h>
+
+static const uint32_t K[64] = {
+    0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5, 0x3956c25b, 0x59f111f1,
+    0x923f82a4, 0xab1c5ed5, 0xd807aa98, 0x12835b01, 0x243185be, 0x550c7dc3,
+    0x72be5d74, 0x80deb1fe, 0x9bdc06a7, 0xc19bf174, 0xe49b69c1, 0xefbe4786,
+    0x0fc19dc6, 0x240ca1cc, 0x2de92c6f, 0x4a7484aa, 0x5cb0a9dc, 0x76f988da,
+    0x983e5152, 0xa831c66d, 0xb00327c8, 0xbf597fc7, 0xc6e00bf3, 0xd5a79147,
+    0x06ca6351, 0x14292967, 0x27b70a85, 0x2e1b2138, 0x4d2c6dfc, 0x53380d13,
+    0x650a7354, 0x766a0abb, 0x81c2c92e, 0x92722c85, 0xa2bfe8a1, 0xa81a664b,
+    0xc24b8b70, 0xc76c51a3, 0xd192e819, 0xd6990624, 0xf40e3585, 0x106aa070,
+    0x19a4c116, 0x1e376c08, 0x2748774c, 0x34b0bcb5, 0x391c0cb3, 0x4ed8aa4a,
+    0x5b9cca4f, 0x682e6ff3, 0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
+    0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2};
+
+#define ROR(x, n) (((x) >> (n)) | ((x) << (32 - (n))))
+
+static void sha256_block_scalar(uint32_t st[8], const uint8_t *p) {
+    uint32_t w[64];
+    for (int i = 0; i < 16; i++)
+        w[i] = ((uint32_t)p[4 * i] << 24) | ((uint32_t)p[4 * i + 1] << 16) |
+               ((uint32_t)p[4 * i + 2] << 8) | p[4 * i + 3];
+    for (int i = 16; i < 64; i++) {
+        uint32_t s0 = ROR(w[i - 15], 7) ^ ROR(w[i - 15], 18) ^ (w[i - 15] >> 3);
+        uint32_t s1 = ROR(w[i - 2], 17) ^ ROR(w[i - 2], 19) ^ (w[i - 2] >> 10);
+        w[i] = w[i - 16] + s0 + w[i - 7] + s1;
+    }
+    uint32_t a = st[0], b = st[1], c = st[2], d = st[3];
+    uint32_t e = st[4], f = st[5], g = st[6], h = st[7];
+    for (int i = 0; i < 64; i++) {
+        uint32_t S1 = ROR(e, 6) ^ ROR(e, 11) ^ ROR(e, 25);
+        uint32_t ch = (e & f) ^ (~e & g);
+        uint32_t t1 = h + S1 + ch + K[i] + w[i];
+        uint32_t S0 = ROR(a, 2) ^ ROR(a, 13) ^ ROR(a, 22);
+        uint32_t maj = (a & b) ^ (a & c) ^ (b & c);
+        uint32_t t2 = S0 + maj;
+        h = g; g = f; f = e; e = d + t1;
+        d = c; c = b; b = a; a = t1 + t2;
+    }
+    st[0] += a; st[1] += b; st[2] += c; st[3] += d;
+    st[4] += e; st[5] += f; st[6] += g; st[7] += h;
+}
+
+#if defined(__SHA__) && defined(__x86_64__)
+#include <immintrin.h>
+static int g_use_ni = 1;
+
+/* Standard SHA-NI block schedule (Intel's published instruction flow). */
+static void sha256_block_ni(uint32_t st[8], const uint8_t *data) {
+    const __m128i SHUF = _mm_set_epi64x(0x0c0d0e0f08090a0bULL,
+                                        0x0405060700010203ULL);
+    __m128i T = _mm_loadu_si128((const __m128i *)&st[0]); /* DCBA */
+    __m128i S1 = _mm_loadu_si128((const __m128i *)&st[4]); /* HGFE */
+    T = _mm_shuffle_epi32(T, 0xB1);        /* CDAB */
+    S1 = _mm_shuffle_epi32(S1, 0x1B);      /* EFGH */
+    __m128i S0 = _mm_alignr_epi8(T, S1, 8); /* ABEF */
+    S1 = _mm_blend_epi16(S1, T, 0xF0);      /* CDGH */
+    const __m128i AS = S0, CS = S1;
+
+    __m128i M0 = _mm_shuffle_epi8(
+        _mm_loadu_si128((const __m128i *)(data + 0)), SHUF);
+    __m128i M1 = _mm_shuffle_epi8(
+        _mm_loadu_si128((const __m128i *)(data + 16)), SHUF);
+    __m128i M2 = _mm_shuffle_epi8(
+        _mm_loadu_si128((const __m128i *)(data + 32)), SHUF);
+    __m128i M3 = _mm_shuffle_epi8(
+        _mm_loadu_si128((const __m128i *)(data + 48)), SHUF);
+    __m128i MSG, TMP;
+
+#define RND2(Mcur, kidx)                                                     \
+    MSG = _mm_add_epi32(Mcur, _mm_loadu_si128((const __m128i *)&K[kidx]));   \
+    S1 = _mm_sha256rnds2_epu32(S1, S0, MSG);                                 \
+    MSG = _mm_shuffle_epi32(MSG, 0x0E);                                      \
+    S0 = _mm_sha256rnds2_epu32(S0, S1, MSG);
+
+#define SCHED(Mm1, Mcur, Mnext, Mprev)                                       \
+    TMP = _mm_alignr_epi8(Mcur, Mm1, 4);                                     \
+    Mnext = _mm_add_epi32(Mnext, TMP);                                       \
+    Mnext = _mm_sha256msg2_epu32(Mnext, Mcur);                               \
+    Mprev = _mm_sha256msg1_epu32(Mprev, Mcur);
+
+    /* rounds 0-15 feed the schedule for 16-63 */
+    RND2(M0, 0);
+    RND2(M1, 4);  M0 = _mm_sha256msg1_epu32(M0, M1);
+    RND2(M2, 8);  M1 = _mm_sha256msg1_epu32(M1, M2);
+    RND2(M3, 12);
+    SCHED(M2, M3, M0, M2);
+    RND2(M0, 16);
+    SCHED(M3, M0, M1, M3);
+    RND2(M1, 20);
+    SCHED(M0, M1, M2, M0);
+    RND2(M2, 24);
+    SCHED(M1, M2, M3, M1);
+    RND2(M3, 28);
+    SCHED(M2, M3, M0, M2);
+    RND2(M0, 32);
+    SCHED(M3, M0, M1, M3);
+    RND2(M1, 36);
+    SCHED(M0, M1, M2, M0);
+    RND2(M2, 40);
+    SCHED(M1, M2, M3, M1);
+    RND2(M3, 44);
+    SCHED(M2, M3, M0, M2);
+    RND2(M0, 48);
+    SCHED(M3, M0, M1, M3);
+    RND2(M1, 52);
+    SCHED(M0, M1, M2, M0);
+    RND2(M2, 56);
+    TMP = _mm_alignr_epi8(M2, M1, 4); /* final schedule: w60..63 */
+    M3 = _mm_add_epi32(M3, TMP);
+    M3 = _mm_sha256msg2_epu32(M3, M2);
+    RND2(M3, 60);
+#undef RND2
+#undef SCHED
+
+    S0 = _mm_add_epi32(S0, AS);
+    S1 = _mm_add_epi32(S1, CS);
+    T = _mm_shuffle_epi32(S0, 0x1B);       /* FEBA */
+    S1 = _mm_shuffle_epi32(S1, 0xB1);      /* DCHG */
+    S0 = _mm_blend_epi16(T, S1, 0xF0);     /* DCBA */
+    S1 = _mm_alignr_epi8(S1, T, 8);        /* HGFE */
+    _mm_storeu_si128((__m128i *)&st[0], S0);
+    _mm_storeu_si128((__m128i *)&st[4], S1);
+}
+
+static void sha256_block(uint32_t st[8], const uint8_t *p) {
+    if (g_use_ni)
+        sha256_block_ni(st, p);
+    else
+        sha256_block_scalar(st, p);
+}
+void sha256_disable_ni(void) { g_use_ni = 0; }
+#else
+static void sha256_block(uint32_t st[8], const uint8_t *p) {
+    sha256_block_scalar(st, p);
+}
+void sha256_disable_ni(void) {}
+#endif
+
+static void sha256(const uint8_t *msg, long len, uint8_t out[32]) {
+    uint32_t st[8] = {0x6a09e667, 0xbb67ae85, 0x3c6ef372, 0xa54ff53a,
+                      0x510e527f, 0x9b05688c, 0x1f83d9ab, 0x5be0cd19};
+    long i = 0;
+    for (; i + 64 <= len; i += 64)
+        sha256_block(st, msg + i);
+    uint8_t tail[128];
+    long rem = len - i;
+    memcpy(tail, msg + i, rem);
+    tail[rem] = 0x80;
+    long tl = (rem + 9 <= 64) ? 64 : 128;
+    memset(tail + rem + 1, 0, tl - rem - 9);
+    uint64_t bits = (uint64_t)len * 8;
+    for (int b = 0; b < 8; b++)
+        tail[tl - 1 - b] = (uint8_t)(bits >> (8 * b));
+    for (long o = 0; o < tl; o += 64)
+        sha256_block(st, tail + o);
+    for (int w = 0; w < 8; w++) {
+        out[4 * w] = (uint8_t)(st[w] >> 24);
+        out[4 * w + 1] = (uint8_t)(st[w] >> 16);
+        out[4 * w + 2] = (uint8_t)(st[w] >> 8);
+        out[4 * w + 3] = (uint8_t)st[w];
+    }
+}
+
+/* Batched plain hashing: n fixed-length items -> 32-byte digests. */
+void sha256_batch(const uint8_t *data, long n, long item_len, uint8_t *out) {
+    for (long i = 0; i < n; i++)
+        sha256(data + i * item_len, item_len, out + 32 * i);
+}
+
+static void h_leaf(const uint8_t *val, long len, uint8_t out[32]) {
+    uint8_t buf[4096];
+    if (len + 1 <= (long)sizeof(buf)) {
+        buf[0] = 0x00;
+        memcpy(buf + 1, val, len);
+        sha256(buf, len + 1, out);
+    } else {
+        /* oversized leaf: hash in two passes is NOT equivalent; callers
+         * keep shards < 4095 bytes (enforced Python-side). */
+        sha256(val, len, out); /* unreachable by contract */
+    }
+}
+
+static void h_node(const uint8_t l[32], const uint8_t r[32], uint8_t out[32]) {
+    uint8_t buf[65];
+    buf[0] = 0x01;
+    memcpy(buf + 1, l, 32);
+    memcpy(buf + 33, r, 32);
+    sha256(buf, 65, out);
+}
+
+/* Validate n proofs, each `reps` times (N receivers re-check the same
+ * echo; repetition keeps measured work honest).  Layout:
+ *   leaf_vals: (n, leaf_len)   paths: (n, depth, 32)
+ *   indices:   (n,) int32      roots: (n, 32)      ok_out: (n,) uint8  */
+void merkle_validate_batch(const uint8_t *leaf_vals, long leaf_len,
+                           const uint8_t *paths, const int32_t *indices,
+                           const uint8_t *roots, long n, long depth,
+                           long reps, uint8_t *ok_out) {
+    uint8_t acc[32];
+    for (long i = 0; i < n; i++) {
+        uint8_t ok = 0;
+        for (long r = 0; r < reps; r++) {
+            h_leaf(leaf_vals + i * leaf_len, leaf_len, acc);
+            int32_t idx = indices[i];
+            for (long d = 0; d < depth; d++) {
+                const uint8_t *sib = paths + (i * depth + d) * 32;
+                if (idx & 1)
+                    h_node(sib, acc, acc);
+                else
+                    h_node(acc, sib, acc);
+                idx >>= 1;
+            }
+            ok = memcmp(acc, roots + 32 * i, 32) == 0;
+        }
+        ok_out[i] = ok;
+    }
+}
+
+/* Batched tree roots: t trees of n_leaves fixed-length leaves, padded to
+ * size (a power of two) with H(0x00) empty leaves; each built `reps`
+ * times.  leaves: (t, n_leaves, leaf_len)  roots_out: (t, 32). */
+void merkle_root_batch(const uint8_t *leaves, long t, long n_leaves,
+                       long leaf_len, long size, long reps,
+                       uint8_t *roots_out) {
+    uint8_t level[256 * 32]; /* size <= 256 leaves per tree */
+    uint8_t empty[32];
+    uint8_t zero = 0x00;
+    sha256(&zero, 1, empty);
+    if (size > 256)
+        return;
+    for (long ti = 0; ti < t; ti++) {
+        for (long r = 0; r < reps; r++) {
+            for (long i = 0; i < n_leaves; i++)
+                h_leaf(leaves + (ti * n_leaves + i) * leaf_len, leaf_len,
+                       level + 32 * i);
+            for (long i = n_leaves; i < size; i++)
+                memcpy(level + 32 * i, empty, 32);
+            for (long w = size; w > 1; w /= 2)
+                for (long i = 0; i < w / 2; i++)
+                    h_node(level + 64 * i, level + 64 * i + 32,
+                           level + 32 * i);
+        }
+        memcpy(roots_out + 32 * ti, level, 32);
+    }
+}
