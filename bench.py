@@ -362,6 +362,47 @@ def bench_epochs_n100() -> dict:
     }
 
 
+def bench_array_engine_n100() -> dict:
+    """North-star macro config through the ARRAY ENGINE: N=100 f=33
+    HoneyBadger epochs/sec, whole-network lockstep execution with the full
+    per-receiver workload (6.94M messages, ~10.7M hashes, ~10⁶ share
+    verifies per epoch — identical counts to the object runtime, see
+    hbbft_tpu/engine/array_engine.py).
+
+    BENCH_ARRAY_BACKEND=tpu routes crypto through the device backend;
+    BENCH_ARRAY_DEDUP=1 reports the memoizing-simulation variant.
+    """
+    from examples.simulation import make_backend
+    from hbbft_tpu.engine import ArrayHoneyBadgerNet
+
+    n = _env_int("BENCH_ARRAY_N", 100)
+    epochs = _env_int("BENCH_ARRAY_EPOCHS", 2)
+    backend = make_backend(os.environ.get("BENCH_ARRAY_BACKEND", "mock"))
+    dedup = os.environ.get("BENCH_ARRAY_DEDUP", "0") == "1"
+    net = ArrayHoneyBadgerNet(
+        range(n), backend=backend, seed=0, dedup_verifies=dedup
+    )
+    net.run_epochs(1, payload_size=64)  # warm: compile/caches
+    t0 = time.perf_counter()
+    net.run_epochs(epochs, payload_size=64)
+    dt = time.perf_counter() - t0
+    eps = epochs / dt if dt > 0 else 0.0
+    rep = net.reports[-1]
+    # Same estimated baseline as bench_epochs_n100: single-core Rust
+    # ~0.1 epochs/s at this config (BASELINE.md cost model).
+    return {
+        "metric": "array_epochs_per_sec_n100",
+        "value": round(eps, 4),
+        "unit": "epochs/s",
+        "vs_baseline": round(eps / 0.1, 3),
+        "baseline": "estimated",
+        "backend": backend.name,
+        "dedup": dedup,
+        "messages_per_epoch": rep.messages_delivered,
+        "dec_share_verifies_per_epoch": rep.dec_shares_verified,
+    }
+
+
 def main() -> None:
     if os.environ.get("BENCH_ONLY"):
         only = set(os.environ["BENCH_ONLY"].split(","))
@@ -373,6 +414,8 @@ def main() -> None:
         ("g2_sign", bench_g2_sign),
         ("rs_encode", bench_rs_encode),
     ]
+    if os.environ.get("BENCH_ARRAY", "1") != "0":
+        extra.append(("array_n100", bench_array_engine_n100))
     if os.environ.get("BENCH_N100", "1") != "0":
         extra.append(("n100", bench_epochs_n100))
 

@@ -1,0 +1,10 @@
+"""Vectorized simulation engine (the "array engine").
+
+See :mod:`hbbft_tpu.engine.array_engine` — the whole-network lockstep
+executor that replaces per-message Python dispatch with per-round batched
+array/crypto operations.
+"""
+
+from hbbft_tpu.engine.array_engine import ArrayHoneyBadgerNet
+
+__all__ = ["ArrayHoneyBadgerNet"]

@@ -1,0 +1,350 @@
+"""ArrayHoneyBadgerNet — the whole network as data (lockstep array engine).
+
+The object runtime (`hbbft_tpu/net/virtual_net.py`) faithfully mirrors the
+reference harness: one Python ``handle_message`` per delivered message.  At
+N=100 an epoch is ~7N³ ≈ 6.9M messages; even at ~70µs each the host layer
+alone takes ~8 minutes per epoch — three orders of magnitude above the
+BASELINE north star, and the same wall the reference's per-message Rust
+design hits (there it is the per-share pairing cost instead).
+
+This module is the TPU-first answer for the *simulation* workload
+(BASELINE.json configs 1/3/5, `examples/simulation.rs` §): run all N nodes
+in **lockstep rounds** — every message sent in round r is delivered in
+round r+1 (a zero-latency full-mesh network, the same schedule the
+round-barrier ``defer_mode="round"`` runtime produces) — and execute each
+round as a handful of *batched* operations over the whole network instead
+of per-message dispatch:
+
+* merkle proof checks:   one batched hash call per round (N³ items)
+* pairing verifications: one batched backend call per round (N³ items)
+* RS encode/decode:      per-instance numpy/GF(2⁸) matmul
+* threshold counting:    plain arithmetic (symmetric under lockstep)
+
+**Workload fidelity.** Per-receiver work is NOT deduplicated: every
+(receiver, sender) pair contributes its own hash validation and its own
+share-verification item, exactly as N independent nodes (and the object
+engine, and the reference) would perform.  Message counts are tallied from
+the same Target expansion rules VirtualNet applies.  The only asymmetry
+the lockstep schedule removes is adversarial interleaving — which the
+object engine retains for correctness testing (differential tests compare
+the two).
+
+**Protocol equivalence.** Under the lockstep schedule with honest nodes the
+per-receiver state machines of broadcast.py / sbv_broadcast.py /
+binary_agreement.py / subset.py / honey_badger.py are symmetric: every
+threshold (N−f Echo, f+1/2f+1 BVal, 2f+1 Ready, N−f Aux/Conf) crosses for
+all receivers in the same round, every RBC decodes in the same round, every
+BA instance receives input ``true`` in the same round and decides ``true``
+in its first round on the fixed coin (binary_agreement.py `_fixed_coin`:
+round 0 → true).  The engine executes exactly those transitions, asserting
+the thresholds it relies on, and produces the same `Batch` values the
+object engine emits under this schedule.
+
+Faulty/adversarial behaviour and latency models stay the object engine's
+job; the array engine targets the honest-path throughput configs.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Sequence
+
+from hbbft_tpu.core.network_info import NetworkInfo
+from hbbft_tpu.crypto.backend import CryptoBackend, MockBackend
+from hbbft_tpu.crypto.erasure import rs_codec
+from hbbft_tpu.crypto.merkle import MerkleTree, _depth, validate_proofs
+from hbbft_tpu.protocols.honey_badger import Batch
+from hbbft_tpu.utils.metrics import Counters
+
+
+@dataclass
+class EpochReport:
+    """Work accounting for one lockstep epoch (all-network totals)."""
+
+    epoch: int
+    rounds: int = 0
+    messages_delivered: int = 0
+    proofs_validated: int = 0
+    hashes: int = 0
+    ciphertexts_verified: int = 0
+    dec_shares_verified: int = 0
+    combines: int = 0
+    rs_encodes: int = 0
+    rs_reconstructs: int = 0
+
+
+class ArrayHoneyBadgerNet:
+    """N-node HoneyBadger network executed in lockstep rounds.
+
+    API shape::
+
+        net = ArrayHoneyBadgerNet(range(100), backend=MockBackend(), seed=7)
+        batches = net.run_epoch({i: contrib_bytes(i) for i in net.ids})
+        # batches[node_id] — identical Batch for every node
+
+    ``dedup_verifies=True`` collapses the N identical copies of each
+    share-verification (each receiver checks the same share against the
+    same public key) to one representative — a *memoizing simulation*
+    mode; the default keeps the full per-receiver workload so measured
+    epochs/sec reflect N independent nodes.
+    """
+
+    def __init__(
+        self,
+        node_ids: Sequence[Any],
+        backend: Optional[CryptoBackend] = None,
+        seed: int = 0,
+        dedup_verifies: bool = False,
+        verify_chunk: int = 1 << 17,
+    ) -> None:
+        self.ids = sorted(node_ids)
+        self.n = len(self.ids)
+        self.f = (self.n - 1) // 3
+        self.backend = backend or MockBackend()
+        self.rng = random.Random(seed)
+        self.netinfos: Dict[Any, NetworkInfo] = NetworkInfo.generate_map(
+            self.ids, self.rng, self.backend
+        )
+        self.dedup_verifies = dedup_verifies
+        self.verify_chunk = verify_chunk
+        self.epoch = 0
+        self.counters = Counters()
+        self.reports: List[EpochReport] = []
+        any_info = self.netinfos[self.ids[0]]
+        self.pk_set = any_info.public_key_set
+        self.pk_master = self.pk_set.public_key()
+        self.threshold = self.pk_set.threshold()
+        self.codec = rs_codec(self.n - 2 * self.f, 2 * self.f)
+
+    # -- helpers -------------------------------------------------------------
+
+    def _count_msgs(self, rep: EpochReport, n_messages: int) -> None:
+        rep.messages_delivered += n_messages
+        self.counters.messages_delivered += n_messages
+
+    def _verify_batch(self, kind: str, items: list) -> List[bool]:
+        """Batched backend verification with chunking (device-batch sized)."""
+        out: List[bool] = []
+        fn = {
+            "sig": self.backend.verify_sig_shares,
+            "dec": self.backend.verify_dec_shares,
+            "ct": self.backend.verify_ciphertexts,
+        }[kind]
+        for i in range(0, len(items), self.verify_chunk):
+            out.extend(fn(items[i : i + self.verify_chunk]))
+        return out
+
+    # -- the epoch -----------------------------------------------------------
+
+    def run_epoch(self, contributions: Dict[Any, bytes]) -> Dict[Any, Batch]:
+        """Execute one full HoneyBadger epoch; returns per-node Batches.
+
+        ``contributions[node] -> bytes`` is each node's proposed payload
+        (what QueueingHoneyBadger would sample from its transaction queue).
+        """
+        n, f = self.n, self.f
+        rep = EpochReport(epoch=self.epoch)
+
+        # ------ round 0: encrypt + RS-encode + Merkle-commit + Value -------
+        # honey_badger.py handle_input: contribution → threshold-encrypt.
+        cts: Dict[Any, Any] = {}
+        for nid in self.ids:
+            cts[nid] = self.pk_master.encrypt(bytes(contributions[nid]), self.rng)
+        ct_bytes = {nid: cts[nid].to_bytes() for nid in self.ids}
+
+        # broadcast.py broadcast(): frame, shard, commit.
+        trees: Dict[Any, MerkleTree] = {}
+        shards: Dict[Any, List[bytes]] = {}
+        for nid in self.ids:
+            framed = len(ct_bytes[nid]).to_bytes(4, "big") + ct_bytes[nid]
+            sh = self.codec.encode(framed)
+            shards[nid] = sh
+            trees[nid] = MerkleTree(sh)
+            rep.rs_encodes += 1
+        tree_size = 1 << _depth(n)  # trees pad to a power of two
+        rep.hashes += n * (2 * tree_size - 1)
+        self._count_msgs(rep, n * (n - 1))  # Value: point-to-point
+        rep.rounds += 1
+
+        # The N² distinct (instance, shard-index) proofs; each is validated
+        # many times across receivers/phases — the repetition count is
+        # passed down so the batched hasher repeats the WORK without
+        # materializing millions of identical Python objects.
+        proofs = [trees[p].proof(s) for p in self.ids for s in range(n)]
+
+        # ------ round 1: validate own Value proof, send Echo ---------------
+        # broadcast.py _handle_value → _validate_proof(own index): each
+        # receiver checks the one proof addressed to it (N² total).
+        ok = validate_proofs(proofs, n, reps=1)
+        assert all(ok), "array engine: proposer produced an invalid proof"
+        rep.proofs_validated += len(proofs)
+        rep.hashes += len(proofs) * (_depth(n) + 1)
+        self._count_msgs(rep, n * n * (n - 1))  # Echo: Target.all per node
+        rep.rounds += 1
+
+        # ------ round 2: validate N echoes each, N−f quorum → Ready --------
+        # broadcast.py _handle_echo: every receiver checks every sender's
+        # shard proof (the O(N³) hash hot loop, batched here: N² distinct
+        # proofs × N receivers each).
+        reps = 1 if self.dedup_verifies else n
+        ok = validate_proofs(proofs, n, reps=reps)
+        assert all(ok), "array engine: honest echo failed validation"
+        rep.proofs_validated += len(proofs) * reps
+        rep.hashes += len(proofs) * reps * (_depth(n) + 1)
+        # Echo count n ≥ N−f for every (instance, receiver): send Ready.
+        assert n >= n - f
+        self._count_msgs(rep, n * n * (n - 1))  # Ready: Target.all
+        rep.rounds += 1
+
+        # ------ round 3: Ready quorum (2f+1) → reconstruct + re-commit -----
+        # broadcast.py _try_decode: all N shards present at every receiver;
+        # reconstruct and re-verify the Merkle commitment.
+        values: Dict[Any, bytes] = {}
+        reps = 1 if self.dedup_verifies else n
+        full_shards: Dict[Any, List[bytes]] = {}
+        for p in self.ids:
+            # every receiver performs this reconstruction:
+            for _ in range(reps):
+                full = self.codec.reconstruct(list(shards[p]))
+            full_shards[p] = full
+            framed = b"".join(full[: self.codec.k])
+            length = int.from_bytes(framed[:4], "big")
+            values[p] = framed[4 : 4 + length]
+            rep.rs_reconstructs += reps
+            rep.hashes += reps * (2 * tree_size - 1)
+        # ... and the Merkle re-commit of the reconstructed shard vector,
+        # batched across instances through the C hash kernel.
+        roots = _roots_batch(
+            [full_shards[p] for p in self.ids], reps
+        )
+        for p, root in zip(self.ids, roots):
+            assert root == trees[p].root_hash
+        for p in self.ids:
+            assert values[p] == ct_bytes[p], "RBC value mismatch"
+        # subset.py _on_broadcast_output: input true to BA_p. BA round 0:
+        # sbv_broadcast.py send_bval → BVal(true) to all.
+        self._count_msgs(rep, n * n * (n - 1))  # BVal
+        rep.rounds += 1
+
+        # ------ round 4: BVal threshold (2f+1) → bin_values, Aux -----------
+        assert n >= 2 * f + 1
+        self._count_msgs(rep, n * n * (n - 1))  # Aux
+        rep.rounds += 1
+
+        # ------ round 5: Aux quorum (N−f) → SBV output {true}, Conf --------
+        self._count_msgs(rep, n * n * (n - 1))  # Conf
+        rep.rounds += 1
+
+        # ------ round 6: Conf quorum → fixed coin (round 0 → true) --------
+        # binary_agreement.py _fixed_coin: round 0 coin is the constant
+        # true; conf_values = {true} is definite and equals the coin →
+        # decide(true) in the first BA round, no threshold-sign traffic.
+        # Every BA decides true → Subset accepts all N proposers.
+        self._count_msgs(rep, n * n * (n - 1))  # Term
+        rep.rounds += 1
+
+        # ------ round 7: ciphertext validation + decryption shares ---------
+        # honey_badger.py: SubsetOutput::Contribution(p, ct) → spawn
+        # ThresholdDecrypt(p); set_ciphertext defers a verify_ciphertext
+        # item per (receiver, proposer).
+        ct_items = []
+        for p in self.ids:
+            ct_obj = cts[p]
+            reps = 1 if self.dedup_verifies else n
+            ct_items.extend([ct_obj] * reps)
+        ok = self._verify_batch("ct", ct_items)
+        assert all(ok), "array engine: honest ciphertext failed validation"
+        rep.ciphertexts_verified += len(ct_items)
+        # threshold_decrypt.py start_decryption: every node multicasts its
+        # decryption share for every accepted proposer.
+        dec_shares: Dict[Any, Dict[int, Any]] = {}
+        for p in self.ids:
+            per_sender: Dict[int, Any] = {}
+            for s_idx, s in enumerate(self.ids):
+                sks = self.netinfos[s].secret_key_share
+                per_sender[s_idx] = sks.decrypt_share_unchecked(cts[p])
+            dec_shares[p] = per_sender
+        self._count_msgs(rep, n * n * (n - 1))  # dec shares: Target.all
+        rep.rounds += 1
+
+        # ------ round 8: verify all shares, combine, emit batches ----------
+        # threshold_decrypt.py handle_message: every receiver verifies every
+        # other sender's share (own share is trusted) — the O(N³) pairing
+        # hot loop, one batched backend dispatch.
+        items = []
+        for p in self.ids:
+            for s_idx in range(n):
+                pk_share = self.pk_set.public_key_share(s_idx)
+                item = (pk_share, cts[p], dec_shares[p][s_idx])
+                reps = 1 if self.dedup_verifies else n - 1
+                items.extend([item] * reps)
+        ok = self._verify_batch("dec", items)
+        assert all(ok), "array engine: honest decryption share rejected"
+        rep.dec_shares_verified += len(items)
+
+        # _try_combine: threshold+1 lowest-indexed verified shares.
+        plain: Dict[Any, bytes] = {}
+        for p in self.ids:
+            chosen = {
+                i: dec_shares[p][i] for i in range(self.threshold + 1)
+            }
+            reps = 1 if self.dedup_verifies else n
+            for _ in range(reps):
+                pt = self.backend.combine_decryption_shares(
+                    self.pk_set, chosen, cts[p]
+                )
+            rep.combines += reps
+            assert pt is not None, "array engine: combine failed"
+            plain[p] = pt
+        for p in self.ids:
+            assert plain[p] == bytes(contributions[p]), "decrypt mismatch"
+        rep.rounds += 1
+
+        batch = Batch(epoch=self.epoch, contributions=dict(plain))
+        self.epoch += 1
+        self.reports.append(rep)
+        self.counters.cranks += rep.rounds
+        return {nid: batch for nid in self.ids}
+
+    def run_epochs(self, k: int, payload_size: int = 128) -> List[Dict[Any, Batch]]:
+        """Run k epochs with synthetic per-node contributions."""
+        out = []
+        for _ in range(k):
+            contribs = {
+                nid: self.rng.getrandbits(8 * payload_size).to_bytes(
+                    payload_size, "big"
+                )
+                for nid in self.ids
+            }
+            out.append(self.run_epoch(contribs))
+        return out
+
+
+def _roots_batch(shard_lists: List[List[bytes]], reps: int) -> List[bytes]:
+    """Merkle roots of many shard vectors, built ``reps`` times each —
+    C batch kernel when available, python MerkleTree otherwise."""
+    import numpy as np
+
+    from hbbft_tpu import native
+
+    n_leaves = len(shard_lists[0])
+    leaf_len = len(shard_lists[0][0])
+    uniform = all(
+        len(sl) == n_leaves and all(len(s) == leaf_len for s in sl)
+        for sl in shard_lists
+    )
+    size = 1 << _depth(n_leaves)
+    if uniform and size <= 256 and leaf_len + 1 <= 4096:
+        leaves = np.frombuffer(
+            b"".join(b"".join(sl) for sl in shard_lists), dtype=np.uint8
+        ).reshape(len(shard_lists), n_leaves, leaf_len)
+        roots = native.merkle_root_batch(leaves, size, reps)
+        if roots is not None:
+            return [roots[i].tobytes() for i in range(len(shard_lists))]
+    out = []
+    for sl in shard_lists:
+        for _ in range(reps):
+            tree = MerkleTree(sl)
+        out.append(tree.root_hash)
+    return out
