@@ -19,7 +19,6 @@ from __future__ import annotations
 import argparse
 import asyncio
 import os
-import pickle
 import random
 import sys
 from typing import Any, Dict, List, Optional, Tuple
@@ -30,24 +29,29 @@ from hbbft_tpu.core.network_info import NetworkInfo
 from hbbft_tpu.core.types import Step
 from hbbft_tpu.crypto.backend import MockBackend
 from hbbft_tpu.protocols.threshold_sign import ThresholdSign
+from hbbft_tpu.utils import canonical, wire
 
 BASE_PORT = 42_000
 
 
-def encode_frame(obj: Any) -> bytes:
-    # DEMO-ONLY WIRE FORMAT: pickle is convenient for arbitrary message
-    # dataclasses but `pickle.loads` on network input is arbitrary code
-    # execution — anything that can reach the localhost port owns this
-    # process.  A real embedder must use the deterministic TLV encoding in
-    # hbbft_tpu/utils/canonical.py (the bincode-equivalent wire discipline).
-    payload = pickle.dumps(obj, protocol=4)
+def encode_frame(sender: int, msg: Any) -> bytes:
+    """(sender, message) → length-prefixed canonical wire bytes.
+
+    The real wire discipline (utils/wire.py): deterministic TLV, decode
+    validates shapes and never executes code — unlike pickle, which an
+    earlier revision of this demo used.
+    """
+    payload = canonical.encode((sender, wire.encode_message(msg)))
     return len(payload).to_bytes(4, "big") + payload
 
 
-async def read_frame(reader: asyncio.StreamReader) -> Any:
+async def read_frame(reader: asyncio.StreamReader, group) -> Any:
     header = await reader.readexactly(4)
     payload = await reader.readexactly(int.from_bytes(header, "big"))
-    return pickle.loads(payload)  # see encode_frame: demo-only, code-exec-trusting
+    sender, msg_bytes = canonical.decode(payload)
+    if not isinstance(sender, int) or not isinstance(msg_bytes, bytes):
+        raise wire.WireError("malformed frame")
+    return sender, wire.decode_message(msg_bytes, group)
 
 
 class PeerNode:
@@ -70,7 +74,15 @@ class PeerNode:
     ) -> None:
         try:
             while True:
-                sender, payload = await read_frame(reader)
+                try:
+                    sender, payload = await read_frame(
+                        reader, self.algo.backend.group
+                    )
+                except wire.WireError as e:
+                    # Malformed frame: drop the connection (framing is lost),
+                    # keep the node alive.
+                    print(f"node {self.id}: dropping peer: {e}", file=sys.stderr)
+                    return
                 step = self.algo.handle_message(sender, payload, rng=self.rng)
                 await self._process(step)
         except (asyncio.IncompleteReadError, ConnectionError):
@@ -112,7 +124,7 @@ class PeerNode:
                 await self._process(follow)
         for tm in step.messages:
             peers = tm.target.recipients(list(range(self.n)), our_id=self.id)
-            frame = encode_frame((self.id, tm.message))
+            frame = encode_frame(self.id, tm.message)
             for to in peers:
                 if to == self.id:
                     continue

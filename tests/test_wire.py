@@ -1,0 +1,118 @@
+"""Wire serialization round-trips for the whole message hierarchy."""
+
+import random
+
+import pytest
+
+from hbbft_tpu.crypto.backend import MockBackend
+from hbbft_tpu.crypto.keys import SecretKeySet
+from hbbft_tpu.crypto.merkle import MerkleTree
+from hbbft_tpu.protocols.binary_agreement import BaMessage
+from hbbft_tpu.protocols.bool_set import BoolSet
+from hbbft_tpu.protocols.broadcast import BroadcastMessage
+from hbbft_tpu.protocols.dynamic_honey_badger import DhbMessage
+from hbbft_tpu.protocols.honey_badger import HbMessage
+from hbbft_tpu.protocols.sbv_broadcast import SbvMessage
+from hbbft_tpu.protocols.sender_queue import SqMessage
+from hbbft_tpu.protocols.subset import SubsetMessage
+from hbbft_tpu.protocols.threshold_decrypt import ThresholdDecryptMessage
+from hbbft_tpu.protocols.threshold_sign import ThresholdSignMessage
+from hbbft_tpu.utils.wire import WireError, decode_message, encode_message
+
+
+@pytest.fixture(scope="module")
+def group():
+    return MockBackend().group
+
+
+@pytest.fixture(scope="module")
+def shares(group):
+    rng = random.Random(4)
+    sks = SecretKeySet.random(group, 1, rng)
+    sig = sks.secret_key_share(0).sign_share(b"doc")
+    ct = sks.public_keys().public_key().encrypt(b"msg0123456789abc", rng)
+    dec = sks.secret_key_share(1).decrypt_share_unchecked(ct)
+    return sig, dec
+
+
+def _roundtrip(msg, group):
+    data = encode_message(msg)
+    assert isinstance(data, bytes)
+    out = decode_message(data, group)
+    assert encode_message(out) == data
+    return out
+
+
+def test_sbv_and_ba(group, shares):
+    sig, _ = shares
+    for msg in (
+        SbvMessage.bval(True),
+        SbvMessage.aux(False),
+        BaMessage.sbv(0, SbvMessage.bval(False)),
+        BaMessage.conf(2, BoolSet.both()),
+        BaMessage.coin(5, ThresholdSignMessage(sig)),
+        BaMessage.term(1, True),
+    ):
+        out = _roundtrip(msg, group)
+        assert type(out) is type(msg)
+
+
+def test_broadcast_proofs(group):
+    tree = MerkleTree([bytes([i]) * 8 for i in range(6)])
+    for msg in (
+        BroadcastMessage.value(tree.proof(2)),
+        BroadcastMessage.echo(tree.proof(5)),
+        BroadcastMessage.ready(tree.root_hash),
+    ):
+        out = _roundtrip(msg, group)
+        assert out == msg
+
+
+def test_full_stack_envelopes(group, shares):
+    sig, dec = shares
+    inner = SubsetMessage(3, "broadcast", BroadcastMessage.ready(b"\x07" * 32))
+    hb = HbMessage.subset(4, inner)
+    dhb = DhbMessage(1, hb)
+    sq = SqMessage.algo(dhb)
+    out = _roundtrip(sq, group)
+    assert out.payload.era == 1
+    assert out.payload.payload.epoch == 4
+    assert out.payload.payload.payload.proposer == 3
+
+    hb2 = HbMessage.dec_share(9, 2, ThresholdDecryptMessage(dec))
+    out = _roundtrip(DhbMessage(0, hb2), group)
+    assert out.payload.kind == "dec_share"
+
+    out = _roundtrip(SqMessage.epoch_started(2, 7), group)
+    assert out.payload == (2, 7)
+
+
+def test_malformed_rejected(group):
+    from hbbft_tpu.utils import canonical
+
+    bad = [
+        b"\xff\x00garbage",
+        canonical.encode(("sbv", "bval", 1)),  # non-bool value
+        canonical.encode(("ba", -1, "term", True)),  # negative round
+        canonical.encode(("ba", 0, "conf", 9)),  # bits out of range
+        canonical.encode(("bc", "ready", b"short")),
+        canonical.encode(("hb", 0, "subset", 1, ("sbv", "bval", True))),
+        canonical.encode(("nope", 1)),
+    ]
+    for data in bad:
+        with pytest.raises(WireError):
+            decode_message(data, group)
+
+
+def test_tampered_share_bytes_rejected(group, shares):
+    sig, _ = shares
+    data = encode_message(ThresholdSignMessage(sig))
+    # flip a byte inside the share encoding
+    broken = bytearray(data)
+    broken[-1] ^= 0xFF
+    try:
+        out = decode_message(bytes(broken), group)
+        # If it still parses, it must at least differ from the original.
+        assert encode_message(out) != data
+    except (WireError, Exception):
+        pass
