@@ -137,13 +137,16 @@ def rs_codec(data_shards: int, parity_shards: int) -> "RSCodec":
 
 
 class RSCodec:
-    """Systematic (k data, m parity) Reed–Solomon codec; n = k+m ≤ 255."""
+    """Systematic (k data, m parity) Reed–Solomon codec; n = k+m ≤ 256."""
 
     def __init__(self, data_shards: int, parity_shards: int) -> None:
         if data_shards < 1 or parity_shards < 0:
             raise ValueError("bad shard counts")
-        if data_shards + parity_shards > 255:
-            raise ValueError("n must be ≤ 255 for GF(2^8)")
+        if data_shards + parity_shards > 256:
+            # GF(2⁸) has exactly 256 distinct evaluation points (0..255),
+            # so 256 total shards is the hard polynomial-interpolation cap
+            # (the N=256 soak config uses all of them).
+            raise ValueError("n must be ≤ 256 for GF(2^8)")
         self.k = data_shards
         self.m = parity_shards
         self.n = data_shards + parity_shards

@@ -161,16 +161,23 @@ def _load_sha() -> Optional[ctypes.CDLL]:
     ]
     lib.merkle_root_batch.restype = None
 
-    # Self-test against hashlib — guards the SHA-NI block schedule (and
+    # Self-test against hashlib — guards the SHA-NI block schedules (and
     # falls back to the scalar path, then to hashlib, on any mismatch).
-    probe = np.frombuffer(b"abc" + bytes(61), dtype=np.uint8).reshape(1, 64)
-    out = np.empty((1, 32), dtype=np.uint8)
-    lib.sha256_batch(np.ascontiguousarray(probe), 1, 64, out)
-    if out.tobytes() != hashlib.sha256(probe.tobytes()).digest():
+    # Two items with distinct contents and a >64-byte length: covers the
+    # dual-stream (x2) path, the single path, and both padding branches.
+    probe = np.frombuffer(
+        b"abc" + bytes(62) + b"defg" + bytes(61), dtype=np.uint8
+    ).reshape(2, 65)
+    want = b"".join(
+        hashlib.sha256(probe[i].tobytes()).digest() for i in range(2)
+    )
+    out = np.empty((2, 32), dtype=np.uint8)
+    lib.sha256_batch(np.ascontiguousarray(probe), 2, 65, out)
+    if out.tobytes() != want:
         try:
             lib.sha256_disable_ni()
-            lib.sha256_batch(np.ascontiguousarray(probe), 1, 64, out)
-            if out.tobytes() != hashlib.sha256(probe.tobytes()).digest():
+            lib.sha256_batch(np.ascontiguousarray(probe), 2, 65, out)
+            if out.tobytes() != want:
                 return None
         except Exception:
             return None

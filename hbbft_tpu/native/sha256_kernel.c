@@ -138,16 +138,130 @@ static void sha256_block_ni(uint32_t st[8], const uint8_t *data) {
     _mm_storeu_si128((__m128i *)&st[4], S1);
 }
 
+/* Two independent blocks interleaved: sha256rnds2 has multi-cycle
+ * latency on a serial 32-deep dependency chain, so one stream leaves the
+ * SHA unit half idle; two streams nearly double throughput. */
+static void sha256_block_ni_x2(uint32_t sa[8], const uint8_t *da,
+                               uint32_t sb[8], const uint8_t *db) {
+    const __m128i SHUF = _mm_set_epi64x(0x0c0d0e0f08090a0bULL,
+                                        0x0405060700010203ULL);
+#define PREP(T, S1, S0, st)                                                  \
+    T = _mm_loadu_si128((const __m128i *)&st[0]);                            \
+    S1 = _mm_loadu_si128((const __m128i *)&st[4]);                           \
+    T = _mm_shuffle_epi32(T, 0xB1);                                          \
+    S1 = _mm_shuffle_epi32(S1, 0x1B);                                        \
+    S0 = _mm_alignr_epi8(T, S1, 8);                                          \
+    S1 = _mm_blend_epi16(S1, T, 0xF0);
+    __m128i Ta, S1a, S0a, Tb, S1b, S0b;
+    PREP(Ta, S1a, S0a, sa);
+    PREP(Tb, S1b, S0b, sb);
+#undef PREP
+    const __m128i ASa = S0a, CSa = S1a, ASb = S0b, CSb = S1b;
+#define LOAD(M, d, off)                                                      \
+    M = _mm_shuffle_epi8(_mm_loadu_si128((const __m128i *)((d) + (off))), SHUF);
+    __m128i M0a, M1a, M2a, M3a, M0b, M1b, M2b, M3b, MSGa, MSGb, TMPa, TMPb;
+    LOAD(M0a, da, 0)  LOAD(M1a, da, 16) LOAD(M2a, da, 32) LOAD(M3a, da, 48)
+    LOAD(M0b, db, 0)  LOAD(M1b, db, 16) LOAD(M2b, db, 32) LOAD(M3b, db, 48)
+#undef LOAD
+
+#define RND2X(Ma, Mb, kidx)                                                  \
+    MSGa = _mm_add_epi32(Ma, _mm_loadu_si128((const __m128i *)&K[kidx]));    \
+    MSGb = _mm_add_epi32(Mb, _mm_loadu_si128((const __m128i *)&K[kidx]));    \
+    S1a = _mm_sha256rnds2_epu32(S1a, S0a, MSGa);                             \
+    S1b = _mm_sha256rnds2_epu32(S1b, S0b, MSGb);                             \
+    MSGa = _mm_shuffle_epi32(MSGa, 0x0E);                                    \
+    MSGb = _mm_shuffle_epi32(MSGb, 0x0E);                                    \
+    S0a = _mm_sha256rnds2_epu32(S0a, S1a, MSGa);                             \
+    S0b = _mm_sha256rnds2_epu32(S0b, S1b, MSGb);
+
+#define SCHEDX(m1a, ca, na, pa, m1b, cb, nb, pb)                             \
+    TMPa = _mm_alignr_epi8(ca, m1a, 4);                                      \
+    TMPb = _mm_alignr_epi8(cb, m1b, 4);                                      \
+    na = _mm_add_epi32(na, TMPa);                                            \
+    nb = _mm_add_epi32(nb, TMPb);                                            \
+    na = _mm_sha256msg2_epu32(na, ca);                                       \
+    nb = _mm_sha256msg2_epu32(nb, cb);                                       \
+    pa = _mm_sha256msg1_epu32(pa, ca);                                       \
+    pb = _mm_sha256msg1_epu32(pb, cb);
+
+    RND2X(M0a, M0b, 0);
+    RND2X(M1a, M1b, 4);
+    M0a = _mm_sha256msg1_epu32(M0a, M1a);
+    M0b = _mm_sha256msg1_epu32(M0b, M1b);
+    RND2X(M2a, M2b, 8);
+    M1a = _mm_sha256msg1_epu32(M1a, M2a);
+    M1b = _mm_sha256msg1_epu32(M1b, M2b);
+    RND2X(M3a, M3b, 12);
+    SCHEDX(M2a, M3a, M0a, M2a, M2b, M3b, M0b, M2b);
+    RND2X(M0a, M0b, 16);
+    SCHEDX(M3a, M0a, M1a, M3a, M3b, M0b, M1b, M3b);
+    RND2X(M1a, M1b, 20);
+    SCHEDX(M0a, M1a, M2a, M0a, M0b, M1b, M2b, M0b);
+    RND2X(M2a, M2b, 24);
+    SCHEDX(M1a, M2a, M3a, M1a, M1b, M2b, M3b, M1b);
+    RND2X(M3a, M3b, 28);
+    SCHEDX(M2a, M3a, M0a, M2a, M2b, M3b, M0b, M2b);
+    RND2X(M0a, M0b, 32);
+    SCHEDX(M3a, M0a, M1a, M3a, M3b, M0b, M1b, M3b);
+    RND2X(M1a, M1b, 36);
+    SCHEDX(M0a, M1a, M2a, M0a, M0b, M1b, M2b, M0b);
+    RND2X(M2a, M2b, 40);
+    SCHEDX(M1a, M2a, M3a, M1a, M1b, M2b, M3b, M1b);
+    RND2X(M3a, M3b, 44);
+    SCHEDX(M2a, M3a, M0a, M2a, M2b, M3b, M0b, M2b);
+    RND2X(M0a, M0b, 48);
+    SCHEDX(M3a, M0a, M1a, M3a, M3b, M0b, M1b, M3b);
+    RND2X(M1a, M1b, 52);
+    SCHEDX(M0a, M1a, M2a, M0a, M0b, M1b, M2b, M0b);
+    RND2X(M2a, M2b, 56);
+    TMPa = _mm_alignr_epi8(M2a, M1a, 4);
+    TMPb = _mm_alignr_epi8(M2b, M1b, 4);
+    M3a = _mm_add_epi32(M3a, TMPa);
+    M3b = _mm_add_epi32(M3b, TMPb);
+    M3a = _mm_sha256msg2_epu32(M3a, M2a);
+    M3b = _mm_sha256msg2_epu32(M3b, M2b);
+    RND2X(M3a, M3b, 60);
+#undef RND2X
+#undef SCHEDX
+
+#define FIN(S0, S1, T, AS, CS, st)                                           \
+    S0 = _mm_add_epi32(S0, AS);                                              \
+    S1 = _mm_add_epi32(S1, CS);                                              \
+    T = _mm_shuffle_epi32(S0, 0x1B);                                         \
+    S1 = _mm_shuffle_epi32(S1, 0xB1);                                        \
+    S0 = _mm_blend_epi16(T, S1, 0xF0);                                       \
+    S1 = _mm_alignr_epi8(S1, T, 8);                                          \
+    _mm_storeu_si128((__m128i *)&st[0], S0);                                 \
+    _mm_storeu_si128((__m128i *)&st[4], S1);
+    FIN(S0a, S1a, Ta, ASa, CSa, sa);
+    FIN(S0b, S1b, Tb, ASb, CSb, sb);
+#undef FIN
+}
+
 static void sha256_block(uint32_t st[8], const uint8_t *p) {
     if (g_use_ni)
         sha256_block_ni(st, p);
     else
         sha256_block_scalar(st, p);
 }
+static void sha256_block_x2(uint32_t sa[8], const uint8_t *pa, uint32_t sb[8],
+                            const uint8_t *pb) {
+    if (g_use_ni) {
+        sha256_block_ni_x2(sa, pa, sb, pb);
+    } else {
+        sha256_block_scalar(sa, pa);
+        sha256_block_scalar(sb, pb);
+    }
+}
 void sha256_disable_ni(void) { g_use_ni = 0; }
 #else
 static void sha256_block(uint32_t st[8], const uint8_t *p) {
     sha256_block_scalar(st, p);
+}
+static void sha256_block_x2(uint32_t sa[8], const uint8_t *pa, uint32_t sb[8],
+                            const uint8_t *pb) {
+    sha256_block_scalar(sa, pa);
+    sha256_block_scalar(sb, pb);
 }
 void sha256_disable_ni(void) {}
 #endif
@@ -177,9 +291,51 @@ static void sha256(const uint8_t *msg, long len, uint8_t out[32]) {
     }
 }
 
+/* Two equal-length messages hashed in lockstep (dual NI streams). */
+static void sha256_x2(const uint8_t *ma, const uint8_t *mb, long len,
+                      uint8_t oa[32], uint8_t ob[32]) {
+    uint32_t sa[8] = {0x6a09e667, 0xbb67ae85, 0x3c6ef372, 0xa54ff53a,
+                      0x510e527f, 0x9b05688c, 0x1f83d9ab, 0x5be0cd19};
+    uint32_t sb[8] = {0x6a09e667, 0xbb67ae85, 0x3c6ef372, 0xa54ff53a,
+                      0x510e527f, 0x9b05688c, 0x1f83d9ab, 0x5be0cd19};
+    long i = 0;
+    for (; i + 64 <= len; i += 64)
+        sha256_block_x2(sa, ma + i, sb, mb + i);
+    uint8_t ta[128], tb[128];
+    long rem = len - i;
+    memcpy(ta, ma + i, rem);
+    memcpy(tb, mb + i, rem);
+    ta[rem] = 0x80;
+    tb[rem] = 0x80;
+    long tl = (rem + 9 <= 64) ? 64 : 128;
+    memset(ta + rem + 1, 0, tl - rem - 9);
+    memset(tb + rem + 1, 0, tl - rem - 9);
+    uint64_t bits = (uint64_t)len * 8;
+    for (int b = 0; b < 8; b++) {
+        ta[tl - 1 - b] = (uint8_t)(bits >> (8 * b));
+        tb[tl - 1 - b] = (uint8_t)(bits >> (8 * b));
+    }
+    for (long o = 0; o < tl; o += 64)
+        sha256_block_x2(sa, ta + o, sb, tb + o);
+    for (int w = 0; w < 8; w++) {
+        oa[4 * w] = (uint8_t)(sa[w] >> 24);
+        oa[4 * w + 1] = (uint8_t)(sa[w] >> 16);
+        oa[4 * w + 2] = (uint8_t)(sa[w] >> 8);
+        oa[4 * w + 3] = (uint8_t)sa[w];
+        ob[4 * w] = (uint8_t)(sb[w] >> 24);
+        ob[4 * w + 1] = (uint8_t)(sb[w] >> 16);
+        ob[4 * w + 2] = (uint8_t)(sb[w] >> 8);
+        ob[4 * w + 3] = (uint8_t)sb[w];
+    }
+}
+
 /* Batched plain hashing: n fixed-length items -> 32-byte digests. */
 void sha256_batch(const uint8_t *data, long n, long item_len, uint8_t *out) {
-    for (long i = 0; i < n; i++)
+    long i = 0;
+    for (; i + 2 <= n; i += 2)
+        sha256_x2(data + i * item_len, data + (i + 1) * item_len, item_len,
+                  out + 32 * i, out + 32 * (i + 1));
+    if (i < n)
         sha256(data + i * item_len, item_len, out + 32 * i);
 }
 
@@ -204,6 +360,31 @@ static void h_node(const uint8_t l[32], const uint8_t r[32], uint8_t out[32]) {
     sha256(buf, 65, out);
 }
 
+static void h_leaf_x2(const uint8_t *va, const uint8_t *vb, long len,
+                      uint8_t oa[32], uint8_t ob[32]) {
+    uint8_t ba[4096], bb[4096];
+    if (len + 1 > 4096)
+        return; /* out of contract (enforced Python-side) */
+    ba[0] = 0x00;
+    bb[0] = 0x00;
+    memcpy(ba + 1, va, len);
+    memcpy(bb + 1, vb, len);
+    sha256_x2(ba, bb, len + 1, oa, ob);
+}
+
+static void h_node_x2(const uint8_t la[32], const uint8_t ra[32],
+                      const uint8_t lb[32], const uint8_t rb[32],
+                      uint8_t oa[32], uint8_t ob[32]) {
+    uint8_t ba[65], bb[65];
+    ba[0] = 0x01;
+    bb[0] = 0x01;
+    memcpy(ba + 1, la, 32);
+    memcpy(ba + 33, ra, 32);
+    memcpy(bb + 1, lb, 32);
+    memcpy(bb + 33, rb, 32);
+    sha256_x2(ba, bb, 65, oa, ob);
+}
+
 /* Validate n proofs, each `reps` times (N receivers re-check the same
  * echo; repetition keeps measured work honest).  Layout:
  *   leaf_vals: (n, leaf_len)   paths: (n, depth, 32)
@@ -212,8 +393,32 @@ void merkle_validate_batch(const uint8_t *leaf_vals, long leaf_len,
                            const uint8_t *paths, const int32_t *indices,
                            const uint8_t *roots, long n, long depth,
                            long reps, uint8_t *ok_out) {
-    uint8_t acc[32];
-    for (long i = 0; i < n; i++) {
+    uint8_t acc[32], acc2[32];
+    /* adjacent items run as dual NI streams; the reps loop (N receivers
+     * re-checking the same proof) stays outermost so the work is honest */
+    long i = 0;
+    for (; i + 2 <= n; i += 2) {
+        uint8_t ok = 0, ok2 = 0;
+        for (long r = 0; r < reps; r++) {
+            h_leaf_x2(leaf_vals + i * leaf_len,
+                      leaf_vals + (i + 1) * leaf_len, leaf_len, acc, acc2);
+            int32_t idx = indices[i], idx2 = indices[i + 1];
+            for (long d = 0; d < depth; d++) {
+                const uint8_t *sib = paths + (i * depth + d) * 32;
+                const uint8_t *sib2 = paths + ((i + 1) * depth + d) * 32;
+                h_node_x2((idx & 1) ? sib : acc, (idx & 1) ? acc : sib,
+                          (idx2 & 1) ? sib2 : acc2, (idx2 & 1) ? acc2 : sib2,
+                          acc, acc2);
+                idx >>= 1;
+                idx2 >>= 1;
+            }
+            ok = memcmp(acc, roots + 32 * i, 32) == 0;
+            ok2 = memcmp(acc2, roots + 32 * (i + 1), 32) == 0;
+        }
+        ok_out[i] = ok;
+        ok_out[i + 1] = ok2;
+    }
+    for (; i < n; i++) {
         uint8_t ok = 0;
         for (long r = 0; r < reps; r++) {
             h_leaf(leaf_vals + i * leaf_len, leaf_len, acc);
@@ -246,15 +451,25 @@ void merkle_root_batch(const uint8_t *leaves, long t, long n_leaves,
         return;
     for (long ti = 0; ti < t; ti++) {
         for (long r = 0; r < reps; r++) {
-            for (long i = 0; i < n_leaves; i++)
-                h_leaf(leaves + (ti * n_leaves + i) * leaf_len, leaf_len,
-                       level + 32 * i);
-            for (long i = n_leaves; i < size; i++)
+            const uint8_t *base = leaves + ti * n_leaves * leaf_len;
+            long i = 0;
+            for (; i + 2 <= n_leaves; i += 2)
+                h_leaf_x2(base + i * leaf_len, base + (i + 1) * leaf_len,
+                          leaf_len, level + 32 * i, level + 32 * (i + 1));
+            for (; i < n_leaves; i++)
+                h_leaf(base + i * leaf_len, leaf_len, level + 32 * i);
+            for (i = n_leaves; i < size; i++)
                 memcpy(level + 32 * i, empty, 32);
-            for (long w = size; w > 1; w /= 2)
-                for (long i = 0; i < w / 2; i++)
+            for (long w = size; w > 1; w /= 2) {
+                long half = w / 2;
+                for (i = 0; i + 2 <= half; i += 2)
+                    h_node_x2(level + 64 * i, level + 64 * i + 32,
+                              level + 64 * (i + 1), level + 64 * (i + 1) + 32,
+                              level + 32 * i, level + 32 * (i + 1));
+                for (; i < half; i++)
                     h_node(level + 64 * i, level + 64 * i + 32,
                            level + 32 * i);
+            }
         }
         memcpy(roots_out + 32 * ti, level, 32);
     }
