@@ -379,8 +379,12 @@ def bench_array_engine_n100() -> dict:
     epochs = _env_int("BENCH_ARRAY_EPOCHS", 2)
     backend = make_backend(os.environ.get("BENCH_ARRAY_BACKEND", "mock"))
     dedup = os.environ.get("BENCH_ARRAY_DEDUP", "0") == "1"
+    # BASELINE config 3 names DynamicHoneyBadger: run the DHB flavor
+    # (internal contribution envelope + the no-churn vote machinery).
+    dynamic = os.environ.get("BENCH_ARRAY_DYNAMIC", "1") == "1"
     net = ArrayHoneyBadgerNet(
-        range(n), backend=backend, seed=0, dedup_verifies=dedup
+        range(n), backend=backend, seed=0, dedup_verifies=dedup,
+        dynamic=dynamic,
     )
     net.run_epochs(1, payload_size=64)  # warm: compile/caches
     t0 = time.perf_counter()
@@ -398,6 +402,7 @@ def bench_array_engine_n100() -> dict:
         "baseline": "estimated",
         "backend": backend.name,
         "dedup": dedup,
+        "dynamic": dynamic,
         "messages_per_epoch": rep.messages_delivered,
         "dec_share_verifies_per_epoch": rep.dec_shares_verified,
     }

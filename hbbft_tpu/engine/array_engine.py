@@ -97,6 +97,7 @@ class ArrayHoneyBadgerNet:
         seed: int = 0,
         dedup_verifies: bool = False,
         verify_chunk: int = 1 << 17,
+        dynamic: bool = False,
     ) -> None:
         self.ids = sorted(node_ids)
         self.n = len(self.ids)
@@ -108,6 +109,13 @@ class ArrayHoneyBadgerNet:
         )
         self.dedup_verifies = dedup_verifies
         self.verify_chunk = verify_chunk
+        #: DynamicHoneyBadger flavor (BASELINE config 3): contributions are
+        #: wrapped in DHB's internal ("icontrib", user, votes, keygen)
+        #: envelope.  With no membership churn the vote/key-gen lists are
+        #: empty, so the batched per-batch signature verification the DHB
+        #: layer performs (dynamic_honey_badger.py _on_hb_batch) has zero
+        #: items — the honest cost of DHB's steady state over HB.
+        self.dynamic = dynamic
         self.epoch = 0
         self.counters = Counters()
         self.reports: List[EpochReport] = []
@@ -147,10 +155,17 @@ class ArrayHoneyBadgerNet:
         rep = EpochReport(epoch=self.epoch)
 
         # ------ round 0: encrypt + RS-encode + Merkle-commit + Value -------
-        # honey_badger.py handle_input: contribution → threshold-encrypt.
+        # honey_badger.py propose(): canonical-encode the contribution
+        # (wrapped in DHB's internal envelope in dynamic mode), then
+        # threshold-encrypt.
+        from hbbft_tpu.utils import canonical
+
         cts: Dict[Any, Any] = {}
         for nid in self.ids:
-            cts[nid] = self.pk_master.encrypt(bytes(contributions[nid]), self.rng)
+            inner: Any = bytes(contributions[nid])
+            if self.dynamic:
+                inner = ("icontrib", inner, (), ())
+            cts[nid] = self.pk_master.encrypt(canonical.encode(inner), self.rng)
         ct_bytes = {nid: cts[nid].to_bytes() for nid in self.ids}
 
         # broadcast.py broadcast(): frame, shard, commit.
@@ -297,11 +312,23 @@ class ArrayHoneyBadgerNet:
             rep.combines += reps
             assert pt is not None, "array engine: combine failed"
             plain[p] = pt
+        # honey_badger.py batch emission: canonical-decode each plaintext;
+        # in dynamic mode additionally unwrap DHB's internal envelope
+        # (dynamic_honey_badger.py _on_hb_batch — its batched per-batch
+        # signature verification runs over the votes/key-gen lists, which
+        # are empty in the no-churn steady state).
+        decoded: Dict[Any, bytes] = {}
         for p in self.ids:
-            assert plain[p] == bytes(contributions[p]), "decrypt mismatch"
+            tree = canonical.decode(plain[p])
+            if self.dynamic:
+                tag, user, votes, kg = tree
+                assert tag == "icontrib" and votes == () and kg == ()
+                tree = user
+            assert tree == bytes(contributions[p]), "decrypt mismatch"
+            decoded[p] = tree
         rep.rounds += 1
 
-        batch = Batch(epoch=self.epoch, contributions=dict(plain))
+        batch = Batch(epoch=self.epoch, contributions=decoded)
         self.epoch += 1
         self.reports.append(rep)
         self.counters.cranks += rep.rounds

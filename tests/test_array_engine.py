@@ -92,3 +92,13 @@ def test_sha_kernel_matches_hashlib():
         out = native.sha256_batch(data)
         for i in range(4):
             assert out[i].tobytes() == hashlib.sha256(data[i].tobytes()).digest()
+
+
+def test_dynamic_mode():
+    """DHB flavor: contributions ride the internal envelope; batches are
+    identical to plain HB mode for the same inputs (no churn)."""
+    ids = range(5)
+    contribs = _contribs(list(ids))
+    hb = ArrayHoneyBadgerNet(ids, backend=MockBackend(), seed=2)
+    dhb = ArrayHoneyBadgerNet(ids, backend=MockBackend(), seed=2, dynamic=True)
+    assert hb.run_epoch(contribs)[0] == dhb.run_epoch(contribs)[0]
