@@ -123,6 +123,22 @@ class CryptoBackend(abc.ABC):
         self.counters.dec_shares_combined += len(shares)
         return pk_set.combine_decryption_shares(shares, ct)
 
+    def combine_dec_shares_batch(
+        self,
+        pk_set: PublicKeySet,
+        items: Sequence[Tuple[Dict[int, DecryptionShare], Ciphertext]],
+    ) -> List[bytes]:
+        """Combine many share sets at once.
+
+        Device backends override this with a single batched dispatch (the
+        share-combination kernel is BASELINE config 5's "ICI all-gather"
+        shape); the default is the per-item loop.
+        """
+        return [
+            self.combine_decryption_shares(pk_set, shares, ct)
+            for shares, ct in items
+        ]
+
     # -- misc ----------------------------------------------------------------
 
     @property

@@ -55,6 +55,7 @@ from hbbft_tpu.crypto.backend import CryptoBackend, MockBackend
 from hbbft_tpu.crypto.erasure import rs_codec
 from hbbft_tpu.crypto.merkle import MerkleTree, _depth, validate_proofs
 from hbbft_tpu.protocols.honey_badger import Batch
+from hbbft_tpu.utils import canonical
 from hbbft_tpu.utils.metrics import Counters
 
 
@@ -158,13 +159,11 @@ class ArrayHoneyBadgerNet:
         # honey_badger.py propose(): canonical-encode the contribution
         # (wrapped in DHB's internal envelope in dynamic mode), then
         # threshold-encrypt.
-        from hbbft_tpu.utils import canonical
-
         cts: Dict[Any, Any] = {}
         for nid in self.ids:
             inner: Any = bytes(contributions[nid])
             if self.dynamic:
-                inner = ("icontrib", inner, (), ())
+                inner = ("icontrib", inner, [], [])  # lists: match DHB propose()
             cts[nid] = self.pk_master.encrypt(canonical.encode(inner), self.rng)
         ct_bytes = {nid: cts[nid].to_bytes() for nid in self.ids}
 
@@ -298,18 +297,27 @@ class ArrayHoneyBadgerNet:
         assert all(ok), "array engine: honest decryption share rejected"
         rep.dec_shares_verified += len(items)
 
-        # _try_combine: threshold+1 lowest-indexed verified shares.
-        plain: Dict[Any, bytes] = {}
+        # _try_combine: threshold+1 lowest-indexed verified shares.  Every
+        # receiver combines independently — all N² combines go through the
+        # backend's batched API (one device dispatch on TpuBackend).
+        reps = 1 if self.dedup_verifies else n
+        combine_items = []
         for p in self.ids:
             chosen = {
                 i: dec_shares[p][i] for i in range(self.threshold + 1)
             }
-            reps = 1 if self.dedup_verifies else n
-            for _ in range(reps):
-                pt = self.backend.combine_decryption_shares(
-                    self.pk_set, chosen, cts[p]
+            combine_items.extend([(chosen, cts[p])] * reps)
+        plains: List[bytes] = []
+        for i in range(0, len(combine_items), self.verify_chunk):
+            plains.extend(
+                self.backend.combine_dec_shares_batch(
+                    self.pk_set, combine_items[i : i + self.verify_chunk]
                 )
-            rep.combines += reps
+            )
+        rep.combines += len(combine_items)
+        plain: Dict[Any, bytes] = {}
+        for j, p in enumerate(self.ids):
+            pt = plains[j * reps]
             assert pt is not None, "array engine: combine failed"
             plain[p] = pt
         # honey_badger.py batch emission: canonical-decode each plaintext;
@@ -322,7 +330,7 @@ class ArrayHoneyBadgerNet:
             tree = canonical.decode(plain[p])
             if self.dynamic:
                 tag, user, votes, kg = tree
-                assert tag == "icontrib" and votes == () and kg == ()
+                assert tag == "icontrib" and votes == [] and kg == []
                 tree = user
             assert tree == bytes(contributions[p]), "decrypt mismatch"
             decoded[p] = tree

@@ -76,6 +76,13 @@ def _jitted_combine_g1():
 
 
 @functools.lru_cache(maxsize=None)
+def _jitted_combine_g1_batch():
+    """vmap of the G1 Lagrange combine over an item axis: (B, k) points ×
+    (B, k, 254) bit matrices → B combined points in one dispatch."""
+    return jax.jit(jax.vmap(curve.linear_combine_g1, in_axes=(0, 0, 0)))
+
+
+@functools.lru_cache(maxsize=None)
 def _jitted_combine_g2():
     return jax.jit(curve.linear_combine_g2)
 
@@ -472,7 +479,70 @@ class TpuBackend(CryptoBackend):
             return pk_set.combine_decryption_shares(shares, ct)
         pts = [(i + 1, s.el) for i, s in sorted(shares.items())]
         self.counters.device_dispatches += 1
-        combined = self._lagrange_device_g1(pts)
+        return self._plaintext_from_combined(self._lagrange_device_g1(pts), ct)
+
+    def _plaintext_from_combined(self, combined, ct: Ciphertext) -> bytes:
+        """Shared tail of threshold decryption: pad = H(s·PK), v ⊕ pad."""
         g = self.group
         pad = g.hash_bytes(g.g1_to_bytes(combined), len(ct.v))
         return bytes(a ^ b for a, b in zip(ct.v, pad))
+
+    def combine_dec_shares_batch(
+        self,
+        pk_set: PublicKeySet,
+        items: Sequence[Tuple[Dict[int, DecryptionShare], Ciphertext]],
+    ) -> List[bytes]:
+        """All combines in ONE device dispatch per share-count group.
+
+        The array engine emits N² combines per epoch (N proposers × N
+        receivers, each over the same f+1 verified share set) — per-item
+        device round-trips would dominate.  Items are grouped by share
+        count k, vmapped over the item axis of a (B, k) Lagrange linear
+        combination, and padded to power-of-two B buckets so XLA compiles
+        a handful of shapes.
+        """
+        out: List[Optional[bytes]] = [None] * len(items)
+        by_k: Dict[int, List[int]] = {}
+        for idx, (shares, _ct) in enumerate(items):
+            if len(shares) <= pk_set.threshold():
+                raise CryptoError(
+                    f"need {pk_set.threshold() + 1} shares, got {len(shares)}"
+                )
+            by_k.setdefault(len(shares), []).append(idx)
+        g = self.group
+        for k, idxs in by_k.items():
+            self.counters.dec_shares_combined += k * len(idxs)
+            if k < self.device_combine_threshold or len(idxs) == 1:
+                for idx in idxs:
+                    shares, ct = items[idx]
+                    out[idx] = pk_set.combine_decryption_shares(shares, ct)
+                continue
+            b = _bucket(len(idxs))
+            flat_pts: List[Any] = []
+            bits_rows = []
+            negs_rows = []
+            for idx in idxs:
+                shares, _ct = items[idx]
+                srt = sorted(shares.items())
+                lam = lagrange_coeffs_at_zero([i + 1 for i, _ in srt])
+                safe = [curve.safe_scalar(l) for l in lam]
+                flat_pts.extend(s.el for _, s in srt)
+                bits_rows.append(curve.scalars_to_bits([s for s, _ in safe]))
+                negs_rows.append([n for _, n in safe])
+            # pad item axis with copies of the first item (discarded)
+            pad = b - len(idxs)
+            flat_pts.extend(flat_pts[:k] * pad)
+            bits_rows.extend([bits_rows[0]] * pad)
+            negs_rows.extend([negs_rows[0]] * pad)
+            P = curve.g1_to_device(flat_pts)
+            P = jax.tree_util.tree_map(
+                lambda c: jnp.reshape(c, (b, k) + c.shape[1:]), P
+            )
+            bits = jnp.asarray(np.stack(bits_rows))
+            negs = jnp.asarray(np.array(negs_rows))
+            self.counters.device_dispatches += 1
+            combined = _jitted_combine_g1_batch()(P, bits, negs)
+            els = curve.g1_from_device(_squeeze_point(combined))
+            for idx, el in zip(idxs, els[: len(idxs)]):
+                out[idx] = self._plaintext_from_combined(el, items[idx][1])
+        return out  # type: ignore[return-value]

@@ -137,3 +137,33 @@ def test_verify_signatures_full(backend, rng):
 def test_empty_batch(backend):
     assert backend.verify_sig_shares([]) == []
     assert backend.verify_ciphertexts([]) == []
+
+
+def test_combine_dec_shares_batch_device_path(backend, keyset, rng):
+    """The vmapped one-dispatch batch combine must match the scalar
+    device combine and the host golden combine bit-for-bit."""
+    sks, pks = keyset
+    items = []
+    msgs = []
+    for j in range(3):
+        msg = bytes([65 + j]) * 12
+        ct = pks.encrypt(msg, rng)
+        shares = {
+            i: sks.secret_key_share(i).decrypt_share_unchecked(ct)
+            for i in (0, 2)
+        }
+        items.append((shares, ct))
+        msgs.append(msg)
+    d0 = backend.counters.device_dispatches
+    backend.device_combine_threshold = 2  # force the device batch path
+    try:
+        got = backend.combine_dec_shares_batch(pks, items)
+    finally:
+        backend.device_combine_threshold = 8
+    assert got == msgs
+    assert backend.counters.device_dispatches == d0 + 1
+    # generic loop (host golden) agrees
+    host = [
+        pks.combine_decryption_shares(shares, ct) for shares, ct in items
+    ]
+    assert host == msgs
