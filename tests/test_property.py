@@ -111,3 +111,46 @@ def test_binary_agreement_decides_same(dim, seed, proposals):
     assert len(decided) == 1
     # Validity: the decision must be someone's proposal.
     assert decided.pop() in set(proposals[:n])
+
+
+@given(seed=st.integers(0, 2**16), data=st.binary(min_size=0, max_size=512))
+@settings(max_examples=60, deadline=None)
+def test_wire_decode_never_executes_or_crashes(seed, data):
+    """Arbitrary bytes into the wire decoder: either a message object or
+    WireError — no other exception type, no code execution."""
+    from hbbft_tpu.crypto.backend import MockBackend
+    from hbbft_tpu.utils.wire import WireError, decode_message, encode_message
+
+    group = MockBackend().group
+    try:
+        msg = decode_message(data, group)
+    except WireError:
+        return
+    # decodable garbage must re-encode deterministically
+    assert isinstance(encode_message(msg), bytes)
+
+
+@given(
+    n=st.integers(4, 9),
+    seed=st.integers(0, 2**12),
+    payload=st.integers(1, 64),
+)
+@settings(max_examples=15, deadline=None,
+          suppress_health_check=[HealthCheck.too_slow])
+def test_array_engine_agreement_property(n, seed, payload):
+    """Any network size / seed / payload size: all nodes output the same
+    batch containing every proposer's contribution."""
+    import random as _random
+
+    from hbbft_tpu.crypto.backend import MockBackend
+    from hbbft_tpu.engine import ArrayHoneyBadgerNet
+
+    rng = _random.Random(seed)
+    net = ArrayHoneyBadgerNet(range(n), backend=MockBackend(), seed=seed)
+    contribs = {
+        i: bytes(rng.randrange(256) for _ in range(payload)) for i in range(n)
+    }
+    batches = net.run_epoch(contribs)
+    first = batches[0]
+    assert all(batches[i] == first for i in range(n))
+    assert first.contributions == contribs
