@@ -54,6 +54,10 @@ def make_backend(name: str):
         from hbbft_tpu.ops.backend import TpuBackend
 
         return TpuBackend()
+    if name == "mesh":
+        from hbbft_tpu.parallel import MeshBackend
+
+        return MeshBackend()
     raise ValueError(f"unknown backend {name!r}")
 
 
@@ -281,7 +285,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--cpu-factor", type=float, default=1.0, help="handling cost ms")
     p.add_argument("--crypto-window", type=int, default=64,
                    help="messages handled between crypto batch flushes")
-    p.add_argument("--backend", choices=("mock", "cpu", "tpu"), default="mock")
+    p.add_argument("--backend", choices=("mock", "cpu", "tpu", "mesh"), default="mock")
     p.add_argument(
         "--engine",
         choices=("object", "array"),

@@ -146,6 +146,15 @@ class TpuBackend(CryptoBackend):
         super().__init__(BLS381Group())
         self._h2_cache: Dict[bytes, Any] = {}
 
+    def _pad_bucket(self, n: int) -> int:
+        """Bucket size for a batch/group axis.  MeshBackend widens this
+        to a multiple of the mesh so the axis shards evenly."""
+        return _bucket(n)
+
+    def _place(self, tree):
+        """Placement hook for jitted-call inputs (MeshBackend shards)."""
+        return tree
+
     # -- internals -----------------------------------------------------------
 
     def _hash_g2(self, doc: bytes):
@@ -168,7 +177,7 @@ class TpuBackend(CryptoBackend):
         g1 = self.group.g1()
         g2 = self.group.g2()
         pad = (g1, g2, g1, g2)  # trivially true
-        b = _bucket(n)
+        b = self._pad_bucket(n)
         quads = list(quads) + [pad] * (b - n)
 
         neg = self.group.g1_neg
@@ -179,7 +188,7 @@ class TpuBackend(CryptoBackend):
         )
         Q2 = pairing.g2_affine_to_device([q[3] for q in quads])
 
-        f = _jitted_product2()(P1, Q1, P2, Q2)
+        f = _jitted_product2()(*self._place((P1, Q1, P2, Q2)))
         f = jax.tree_util.tree_map(np.asarray, f)
         return [pairing.is_one_host(f, i) for i in range(n)]
 
@@ -231,7 +240,7 @@ class TpuBackend(CryptoBackend):
         if not groups:
             return
         k = _bucket(max(len(g) for g in groups))
-        g = _bucket(len(groups))
+        g = self._pad_bucket(len(groups))
         pad_group = [None] * k
         padded: List[List[Optional[int]]] = [
             list(grp) + [None] * (k - len(grp)) for grp in groups
@@ -248,7 +257,8 @@ class TpuBackend(CryptoBackend):
         self.counters.rlc_groups += len(groups)
         self.counters.device_dispatches += 1
         args = build_group_arrays(padded, g, k)
-        f = jitted(*args, jnp.asarray(rbits))
+        placed = self._place(tuple(args) + (jnp.asarray(rbits),))
+        f = jitted(*placed)
         f = jax.tree_util.tree_map(np.asarray, f)
         for gi, grp in enumerate(groups):
             if pairing.is_one_host(f, gi):
@@ -517,7 +527,7 @@ class TpuBackend(CryptoBackend):
                     shares, ct = items[idx]
                     out[idx] = pk_set.combine_decryption_shares(shares, ct)
                 continue
-            b = _bucket(len(idxs))
+            b = self._pad_bucket(len(idxs))
             flat_pts: List[Any] = []
             bits_rows = []
             negs_rows = []
@@ -541,7 +551,7 @@ class TpuBackend(CryptoBackend):
             bits = jnp.asarray(np.stack(bits_rows))
             negs = jnp.asarray(np.array(negs_rows))
             self.counters.device_dispatches += 1
-            combined = _jitted_combine_g1_batch()(P, bits, negs)
+            combined = _jitted_combine_g1_batch()(*self._place((P, bits, negs)))
             els = curve.g1_from_device(_squeeze_point(combined))
             for idx, el in zip(idxs, els[: len(idxs)]):
                 out[idx] = self._plaintext_from_combined(el, items[idx][1])

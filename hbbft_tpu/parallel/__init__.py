@@ -1,3 +1,4 @@
+from hbbft_tpu.parallel.backend import MeshBackend
 from hbbft_tpu.parallel.mesh import (
     BATCH_AXIS,
     device_mesh,
@@ -8,6 +9,7 @@ from hbbft_tpu.parallel.mesh import (
 
 __all__ = [
     "BATCH_AXIS",
+    "MeshBackend",
     "device_mesh",
     "shard_batch",
     "sharded_combine_g2_fn",

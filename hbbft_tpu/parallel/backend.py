@@ -1,0 +1,53 @@
+"""MeshBackend — the multi-chip crypto backend (ICI/DCN scaling axis).
+
+TpuBackend resolves whole verification/combination batches in single-chip
+jitted dispatches; MeshBackend is the same backend with every batch/group
+axis sharded over a ``jax.sharding.Mesh`` (BASELINE config 5: "QHB N=256
+sustained").  All sharded paths are data-parallel over the item/group
+axis — per-item pairing work and per-item Lagrange ladders partition
+across chips with no cross-chip traffic until the host gathers results.
+The cross-shard Jacobian reduction (one combine whose SHARES span chips,
+the literal "ICI all-gather of shares") is the separate
+``parallel/mesh.sharded_combine_g2_fn`` kernel, exercised by the
+multichip dryrun; protocol workloads batch many independent combines, so
+the data-parallel form is the one the backend seam dispatches.
+
+Works identically on a real multi-chip slice and on the virtual
+8-device CPU mesh (tests/conftest.py) — the mesh is the only knob.
+
+Reference analogue: none — the reference is sans-I/O and single-process
+(SURVEY.md §2.3); this is the TPU-native replacement for the scaling the
+reference delegates to its embedder.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from jax.sharding import Mesh
+
+from hbbft_tpu.ops.backend import TpuBackend, _bucket
+from hbbft_tpu.parallel.mesh import device_mesh, shard_batch
+
+
+class MeshBackend(TpuBackend):
+    """TpuBackend with batch axes sharded over a device mesh."""
+
+    def __init__(self, mesh: Optional[Mesh] = None) -> None:
+        super().__init__()
+        self.mesh = mesh or device_mesh()
+        self._n_dev = self.mesh.devices.size
+
+    def _pad_bucket(self, n: int) -> int:
+        # power-of-two bucket, widened so the sharded axis splits evenly
+        # (lcm handles non-power-of-two meshes, e.g. 6 devices)
+        import math
+
+        return math.lcm(_bucket(n), self._n_dev)
+
+    def _place(self, tree):
+        return shard_batch(tree, self.mesh)
+
+    @property
+    def name(self) -> str:
+        return f"MeshBackend[{self._n_dev}]"
