@@ -41,11 +41,22 @@ from hbbft_tpu.ops import fq
 
 TILE = 512  # lanes per grid step: 4 × (8, 128) VPU tiles
 
-# Convolution strategy inside the kernel: "concat" builds each shifted
-# partial product as zero-pad concatenations (functional, many VMEM
-# copies); "scratch" accumulates into a VMEM scratch ref with static-slice
-# read-modify-writes (one pass of traffic).  Selectable for A/B timing.
+# Convolution strategy inside the kernel (selectable for A/B timing via
+# HBBFT_TPU_CONV_MODE; module-level so tests can exercise every mode):
+#   "concat"  — each shifted partial product via zero-pad concatenations
+#               (functional, many VMEM copies)
+#   "scratch" — accumulate into a VMEM scratch ref with static-slice
+#               read-modify-writes (one pass of traffic, but the slice
+#               offsets i are sublane-misaligned for 7 of 8 steps)
+#   "grouped" — decompose the shift i = 8q + r: accumulate per-residue
+#               partials at ALIGNED offsets 8q into an (8, CONV_PAD, T)
+#               scratch, then apply only 8 misaligned shifts (one per r)
+#               at the end instead of NLIMBS of them
 _CONV_MODE = os.environ.get("HBBFT_TPU_CONV_MODE", "scratch")
+
+_SUB = 8  # sublane granularity the "grouped" mode aligns to
+_NLIMBS_PAD = -(-fq.NLIMBS // _SUB) * _SUB  # 56 for the 8-bit config
+_CONV_PAD = _SUB * ((fq.NLIMBS - 1) // _SUB) + _NLIMBS_PAD
 
 # FOLD columns: FOLD_T[:, j] = canonical limbs of 2^(BITS·(FOLD_FROM+j)) mod Q.
 _FOLD_T = np.ascontiguousarray(fq._FOLD_ROWS.T)  # (NLIMBS, CONV - FOLD_FROM)
@@ -87,6 +98,31 @@ def _conv_concat(a, b):
     return acc
 
 
+def _conv_grouped(a, b, acc8_ref):
+    """Aligned-offset accumulation: P_r[8q:8q+PAD] += a[8q+r]·b_pad, then
+    c = Σ_r shift_r(P_r).  Only 8 misaligned row-shifts total."""
+    t = a.shape[1]
+    b_pad = jnp.concatenate(
+        [b, jnp.zeros((_NLIMBS_PAD - fq.NLIMBS, t), dtype=fq.DTYPE)], axis=0
+    )
+    acc8_ref[...] = jnp.zeros_like(acc8_ref)
+    for r in range(_SUB):
+        for q in range((fq.NLIMBS - 1 - r) // _SUB + 1):
+            i = _SUB * q + r
+            if i >= fq.NLIMBS:
+                break
+            acc8_ref[r, _SUB * q : _SUB * q + _NLIMBS_PAD, :] += (
+                a[i : i + 1, :] * b_pad
+            )
+    c = acc8_ref[0, : fq.CONV, :]
+    for r in range(1, _SUB):
+        p = acc8_ref[r, : fq.CONV - r, :]
+        c = c + jnp.concatenate(
+            [jnp.zeros((r, t), dtype=fq.DTYPE), p], axis=0
+        )
+    return c
+
+
 def _mul_kernel(a_ref, b_ref, fold_ref, out_ref, acc_ref=None):
     a = _carry_cols(a_ref[:])  # (NLIMBS, T), limbs ≤ BASE+1
     b = _carry_cols(b_ref[:])
@@ -96,6 +132,8 @@ def _mul_kernel(a_ref, b_ref, fold_ref, out_ref, acc_ref=None):
 
     if acc_ref is None:
         c = _conv_concat(a, b)
+    elif len(acc_ref.shape) == 3:
+        c = _conv_grouped(a, b, acc_ref)
     else:
         # One-pass accumulation into VMEM scratch: each step is a 50-row
         # static-slice read-modify-write instead of a 99-row concat+add.
@@ -123,10 +161,12 @@ def _mul_kernel(a_ref, b_ref, fold_ref, out_ref, acc_ref=None):
 
 
 @functools.lru_cache(maxsize=None)
-def _mul_call(n_tiles: int, interpret: bool):
+def _mul_call(n_tiles: int, interpret: bool, mode: str):
     scratch = []
-    if _CONV_MODE == "scratch":
+    if mode == "scratch":
         scratch = [pltpu.VMEM((fq.CONV, TILE), fq.DTYPE)]
+    elif mode == "grouped":
+        scratch = [pltpu.VMEM((_SUB, _CONV_PAD, TILE), fq.DTYPE)]
     return pl.pallas_call(
         _mul_kernel,
         out_shape=jax.ShapeDtypeStruct((fq.NLIMBS, n_tiles * TILE), fq.DTYPE),
@@ -157,5 +197,5 @@ def mul(a: jnp.ndarray, b: jnp.ndarray, interpret: bool = False) -> jnp.ndarray:
     if pad:
         flat_a = jnp.pad(flat_a, ((0, 0), (0, pad)))
         flat_b = jnp.pad(flat_b, ((0, 0), (0, pad)))
-    out = _mul_call(n_tiles, interpret)(flat_a, flat_b, jnp.asarray(_FOLD_T))
+    out = _mul_call(n_tiles, interpret, _CONV_MODE)(flat_a, flat_b, jnp.asarray(_FOLD_T))
     return out[:, :lanes].T.reshape(shape)

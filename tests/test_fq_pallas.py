@@ -51,3 +51,16 @@ def test_vmap(rng):
     for i in range(2):
         for j in range(3):
             assert fq.to_int(out[i, j]) == (xs[i][j] * ys[i][j]) % Q
+
+
+def test_all_conv_modes_match_golden(rng, monkeypatch):
+    """Every convolution strategy (concat / scratch / grouped) computes the
+    same product — the modes exist only for on-chip A/B timing."""
+    xs = [rng.randrange(fq.Q) for _ in range(8)]
+    ys = [rng.randrange(fq.Q) for _ in range(8)]
+    a, b = fq.from_ints(xs), fq.from_ints(ys)
+    want = [(x * y) % fq.Q for x, y in zip(xs, ys)]
+    for mode in ("concat", "scratch", "grouped"):
+        monkeypatch.setattr(fq_pallas, "_CONV_MODE", mode)
+        got = fq.to_ints(np.asarray(fq_pallas.mul(a, b, interpret=True)))
+        assert got == want, mode
