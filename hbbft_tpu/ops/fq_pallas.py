@@ -114,12 +114,22 @@ def _conv_grouped(a, b, acc8_ref):
             acc8_ref[r, _SUB * q : _SUB * q + _NLIMBS_PAD, :] += (
                 a[i : i + 1, :] * b_pad
             )
-    c = acc8_ref[0, : fq.CONV, :]
-    for r in range(1, _SUB):
-        p = acc8_ref[r, : fq.CONV - r, :]
-        c = c + jnp.concatenate(
-            [jnp.zeros((r, t), dtype=fq.DTYPE), p], axis=0
-        )
+    # Assemble c[CONV] = Σ_r shift_r(P_r).  P_r rows beyond CONV−r hold only
+    # zero-padding products (i+j ≤ CONV−1 always), so truncation is safe;
+    # conversely pad up when _CONV_PAD < CONV (the 11-bit configuration).
+    c = None
+    for r in range(_SUB):
+        rows = min(_CONV_PAD, fq.CONV - r)
+        part = acc8_ref[r, :rows, :]
+        pieces = []
+        if r:
+            pieces.append(jnp.zeros((r, t), dtype=fq.DTYPE))
+        pieces.append(part)
+        tail = fq.CONV - r - rows
+        if tail:
+            pieces.append(jnp.zeros((tail, t), dtype=fq.DTYPE))
+        shifted = jnp.concatenate(pieces, axis=0) if len(pieces) > 1 else part
+        c = shifted if c is None else c + shifted
     return c
 
 
