@@ -2,11 +2,36 @@
 
 from __future__ import annotations
 
+import hashlib
 import os
+import platform
+
+
+def _host_tag() -> str:
+    """CPU-feature fingerprint for the cache directory name.
+
+    XLA:CPU persists AOT executables whose cache key does NOT include the
+    host's CPU features; loading an entry compiled on a machine with a
+    different feature set SIGILLs/SIGSEGVs inside
+    ``compilation_cache.get_executable_and_time`` (observed when this
+    sandbox migrated hosts mid-session).  Same defense as
+    hbbft_tpu/native's .so cache naming.
+    """
+    feat = ""
+    try:
+        with open("/proc/cpuinfo") as f:
+            feat = next(
+                (ln for ln in f if ln.startswith(("flags", "Features"))), ""
+            )
+    except OSError:
+        pass
+    digest = hashlib.sha256((platform.machine() + feat).encode()).hexdigest()
+    return f"{platform.machine()}-{digest[:12]}"
 
 
 def enable_compile_cache(cache_dir: str | None = None) -> None:
-    """Point JAX's persistent compilation cache at the repo-local dir.
+    """Point JAX's persistent compilation cache at a repo-local,
+    host-fingerprinted dir.
 
     The pairing graphs take tens of seconds (CPU: minutes pre-stacking) to
     compile; the cache makes every subsequent process — tests, bench, the
@@ -17,7 +42,7 @@ def enable_compile_cache(cache_dir: str | None = None) -> None:
 
     if cache_dir is None:
         repo = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-        cache_dir = os.path.join(repo, ".jax_cache")
+        cache_dir = os.path.join(repo, f".jax_cache.{_host_tag()}")
     try:
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
