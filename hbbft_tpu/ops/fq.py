@@ -314,9 +314,15 @@ def select(cond: jnp.ndarray, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
 def pow_fixed(x: jnp.ndarray, exponent: int) -> jnp.ndarray:
     """x^exponent for a Python-int exponent baked into the graph.
 
-    Uses a lax.scan over the fixed bit schedule (MSB first) so the graph
-    stays O(1) in exponent length: per step one square + one select-mul.
+    On TPU the whole square-and-multiply chain runs inside ONE Pallas
+    kernel (fq_pallas.pow_fixed) — the scan form below dispatches 2
+    kernel calls per exponent bit, which at ~100 µs fixed cost per call
+    dominates everything for the 381-bit Fermat inverse.
     """
+    if exponent >= 1 and _use_pallas():
+        from hbbft_tpu.ops import fq_pallas
+
+        return fq_pallas.pow_fixed(x, exponent)
     bits = [int(b) for b in bin(exponent)[2:]]
     bits_arr = jnp.asarray(bits, dtype=jnp.int32)
 

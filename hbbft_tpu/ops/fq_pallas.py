@@ -52,7 +52,13 @@ TILE = 512  # lanes per grid step: 4 × (8, 128) VPU tiles
 #               partials at ALIGNED offsets 8q into an (8, CONV_PAD, T)
 #               scratch, then apply only 8 misaligned shifts (one per r)
 #               at the end instead of NLIMBS of them
-_CONV_MODE = os.environ.get("HBBFT_TPU_CONV_MODE", "scratch")
+# Measured on a v5e (tools/kernel_bench.py, 2026-07-30), M muls/s at
+# 4k/16k/64k/256k lanes: grouped 33/89/177/217, scratch 22/108/141/208,
+# concat 21/70/172/209.  Grouped is the default for its clear win in the
+# small-lane regime the Miller loop actually runs in (and at 64k+);
+# scratch holds a lead in the mid (16k) regime — revisit if a workload
+# lives there.
+_CONV_MODE = os.environ.get("HBBFT_TPU_CONV_MODE", "grouped")
 
 _SUB = 8  # sublane granularity the "grouped" mode aligns to
 _NLIMBS_PAD = -(-fq.NLIMBS // _SUB) * _SUB  # 56 for the 8-bit config
@@ -133,10 +139,10 @@ def _conv_grouped(a, b, acc8_ref):
     return c
 
 
-def _mul_kernel(a_ref, b_ref, fold_ref, out_ref, acc_ref=None):
-    a = _carry_cols(a_ref[:])  # (NLIMBS, T), limbs ≤ BASE+1
-    b = _carry_cols(b_ref[:])
-    fold_t = fold_ref[:]
+def _mul_core(a, b, fold_t, acc_ref):
+    """CARRIED operands (NLIMBS, T) → carried product.  The shared
+    conv+carry+fold pipeline used by every kernel in this module; the
+    conv strategy is chosen by the scratch ref's presence/shape."""
     ff = fq.FOLD_FROM
     t = a.shape[1]
 
@@ -167,7 +173,13 @@ def _mul_kernel(a_ref, b_ref, fold_ref, out_ref, acc_ref=None):
         [out[:ff], jnp.zeros((nhi, t), dtype=fq.DTYPE)], axis=0
     ) + jnp.dot(fold_t[:, :nhi], out[ff:], preferred_element_type=fq.DTYPE)
 
-    out_ref[:] = _carry_cols(out2)
+    return _carry_cols(out2)
+
+
+def _mul_kernel(a_ref, b_ref, fold_ref, out_ref, acc_ref=None):
+    a = _carry_cols(a_ref[:])  # (NLIMBS, T), limbs ≤ BASE+1
+    b = _carry_cols(b_ref[:])
+    out_ref[:] = _mul_core(a, b, fold_ref[:], acc_ref)
 
 
 @functools.lru_cache(maxsize=None)
@@ -192,20 +204,107 @@ def _mul_call(n_tiles: int, interpret: bool, mode: str):
     )
 
 
+def _to_cols(x: jnp.ndarray, lanes: int, n_tiles: int) -> jnp.ndarray:
+    """(..., NLIMBS) → limbs-first padded (NLIMBS, n_tiles·TILE)."""
+    flat = x.reshape(lanes, fq.NLIMBS).T
+    pad = n_tiles * TILE - lanes
+    return jnp.pad(flat, ((0, 0), (0, pad))) if pad else flat
+
+
+def _from_cols(out: jnp.ndarray, lanes: int, shape) -> jnp.ndarray:
+    return out[:, :lanes].T.reshape(shape)
+
+
+def _lane_count(shape) -> tuple:
+    lanes = 1
+    for d in shape[:-1]:
+        lanes *= d
+    return lanes, max(1, -(-lanes // TILE))
+
+
 def mul(a: jnp.ndarray, b: jnp.ndarray, interpret: bool = False) -> jnp.ndarray:
     """Drop-in for fq.mul on TPU: (..., NLIMBS) lazy residues in, same out."""
     shape = jnp.broadcast_shapes(jnp.shape(a), jnp.shape(b))
     a = jnp.broadcast_to(jnp.asarray(a, fq.DTYPE), shape)
     b = jnp.broadcast_to(jnp.asarray(b, fq.DTYPE), shape)
-    lanes = 1
-    for d in shape[:-1]:
-        lanes *= d
-    flat_a = a.reshape(lanes, fq.NLIMBS).T
-    flat_b = b.reshape(lanes, fq.NLIMBS).T
-    n_tiles = max(1, -(-lanes // TILE))
-    pad = n_tiles * TILE - lanes
-    if pad:
-        flat_a = jnp.pad(flat_a, ((0, 0), (0, pad)))
-        flat_b = jnp.pad(flat_b, ((0, 0), (0, pad)))
-    out = _mul_call(n_tiles, interpret, _CONV_MODE)(flat_a, flat_b, jnp.asarray(_FOLD_T))
-    return out[:, :lanes].T.reshape(shape)
+    lanes, n_tiles = _lane_count(shape)
+    out = _mul_call(n_tiles, interpret, _CONV_MODE)(
+        _to_cols(a, lanes, n_tiles),
+        _to_cols(b, lanes, n_tiles),
+        jnp.asarray(_FOLD_T),
+    )
+    return _from_cols(out, lanes, shape)
+
+
+# ---------------------------------------------------------------------------
+# Fixed-exponent power: the whole square-and-multiply chain in ONE kernel.
+#
+# The XLA path (fq.pow_fixed) lowers to a lax.scan whose every iteration
+# dispatches 2 stacked multiplies — for the 381-bit Fermat inverse that is
+# ~760 sequential Pallas calls at ~100 µs fixed overhead each (~80 ms per
+# verification graph, the dominant cost of final_exponentiation's easy
+# part at protocol batch sizes).  Here the bit loop runs INSIDE the kernel
+# (jax.lax.fori_loop over a scalar-prefetched bit schedule in SMEM), so
+# the chain costs one kernel launch and never leaves VMEM.
+# ---------------------------------------------------------------------------
+
+
+def _pow_kernel(bits_ref, x_ref, fold_ref, out_ref, acc_ref=None):
+    x = _carry_cols(x_ref[:])
+    fold_t = fold_ref[:]
+    nbits = bits_ref.shape[0]
+
+    def body(i, acc):
+        sq = _mul_core(acc, acc, fold_t, acc_ref)
+        withx = _mul_core(sq, x, fold_t, acc_ref)
+        # SMEM scalar read with a traced index; blend keeps the body
+        # branch-free (both products always run — the set-bit density of
+        # the Fermat exponent is ~60%, so a cond would save little).
+        return jnp.where(bits_ref[i] > 0, withx, sq)
+
+    # MSB is implicit: acc starts at x, loop covers bits [1, nbits).
+    out_ref[:] = jax.lax.fori_loop(1, nbits, body, x)
+
+
+@functools.lru_cache(maxsize=None)
+def _pow_call(n_tiles: int, nbits: int, interpret: bool, mode: str):
+    scratch = []
+    if mode == "scratch":
+        scratch = [pltpu.VMEM((fq.CONV, TILE), fq.DTYPE)]
+    elif mode == "grouped":
+        scratch = [pltpu.VMEM((_SUB, _CONV_PAD, TILE), fq.DTYPE)]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n_tiles,),
+        in_specs=[
+            pl.BlockSpec((fq.NLIMBS, TILE), lambda i, *_: (0, i)),
+            pl.BlockSpec(
+                (fq.NLIMBS, fq.CONV - fq.FOLD_FROM), lambda i, *_: (0, 0)
+            ),
+        ],
+        out_specs=pl.BlockSpec((fq.NLIMBS, TILE), lambda i, *_: (0, i)),
+        scratch_shapes=scratch,
+    )
+    return pl.pallas_call(
+        _pow_kernel,
+        out_shape=jax.ShapeDtypeStruct((fq.NLIMBS, n_tiles * TILE), fq.DTYPE),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )
+
+
+def pow_fixed(x: jnp.ndarray, exponent: int, interpret: bool = False) -> jnp.ndarray:
+    """x^exponent for a Python-int exponent ≥ 1 — one kernel launch.
+
+    Drop-in for fq.pow_fixed on TPU; (..., NLIMBS) lazy residues in/out.
+    """
+    if exponent < 1:
+        raise ValueError("pow_fixed kernel requires exponent >= 1")
+    bits = np.asarray([int(b) for b in bin(exponent)[2:]], dtype=np.int32)
+    shape = jnp.shape(x)
+    x = jnp.asarray(x, fq.DTYPE)
+    lanes, n_tiles = _lane_count(shape)
+    out = _pow_call(n_tiles, len(bits), interpret, _CONV_MODE)(
+        jnp.asarray(bits), _to_cols(x, lanes, n_tiles), jnp.asarray(_FOLD_T)
+    )
+    return _from_cols(out, lanes, shape)

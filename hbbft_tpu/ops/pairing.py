@@ -33,6 +33,7 @@ FE(ML(a,b)·ML(−c,d)) == 1, two Miller loops and ONE final exp).
 
 from __future__ import annotations
 
+import os
 from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -43,6 +44,15 @@ import jax.numpy as jnp
 from hbbft_tpu.crypto.bls381 import BLS_X, BLS_X_IS_NEG
 from hbbft_tpu.crypto.field import Q, R as SUBGROUP_R
 from hbbft_tpu.ops import curve, fq, tower
+
+
+def _use_fused() -> bool:
+    """Route the Miller loop / final exp through the fused whole-block
+    Pallas kernels (ops/pairing_fused.py) on TPU.  The unfused stacked
+    path stays as the golden cross-check (HBBFT_TPU_NO_FUSED=1)."""
+    if os.environ.get("HBBFT_TPU_NO_FUSED"):
+        return False
+    return fq._use_pallas()
 
 # Exponents for the final exponentiation.
 _EASY_DONE_HARD = (Q**4 - Q**2 + 1) // SUBGROUP_R
@@ -229,6 +239,11 @@ def miller_loop(P, Qa):
     segmented unrolling achieved the same arithmetic but blew the XLA
     CPU compiler up on larger composed graphs.)
     """
+    if _use_fused():
+        from hbbft_tpu.ops import pairing_fused
+
+        return pairing_fused.miller_loop(P, Qa)
+
     xP, yP, infP = P
     xQ, yQ, infQ = Qa
     batch_shape = jnp.asarray(xP).shape[:-1]
@@ -262,11 +277,51 @@ def miller_loop(P, Qa):
 
 
 def miller_product(pairs):
-    """Π_k ML(P_k, Q_k) per item — pairs is a list of (P, Qa) batches."""
-    f = None
-    for P, Qa in pairs:
-        fk = miller_loop(P, Qa)
-        f = fk if f is None else tower.fq12_mul(f, fk)
+    """Π_k ML(P_k, Q_k) per item — pairs is a list of (P, Qa) batches.
+
+    The k loops are fused into ONE batched scan by stacking the pairs
+    along the leading axis: same arithmetic, but every stacked limb
+    multiply carries k× the lanes and the scan runs once instead of k
+    times.  The kernel's throughput rises steeply with lane count in
+    this regime (measured 33→89 M muls/s from 4k→16k lanes on a v5e),
+    so for the k=2 verification shape this is close to a 2× win over
+    sequential loops.
+    """
+    if len(pairs) == 1:
+        return miller_loop(*pairs[0])
+
+    # The stacked scan needs every pair batched (rank ≥ 2 leaves) with one
+    # common batch size; anything else falls back to sequential loops
+    # rather than silently concatenating along the wrong axis.  The
+    # HBBFT_TPU_NO_FUSED baseline switch also forces the sequential form so
+    # A/B runs compare against the true pre-merge graph.
+    ranks = {jnp.ndim(p[0][0]) for p in pairs}
+    batches = {jnp.shape(p[0][0])[0] for p in pairs}
+    if (
+        ranks != {2}
+        or len(batches) != 1
+        or os.environ.get("HBBFT_TPU_NO_FUSED")
+    ):
+        f = None
+        for P, Qa in pairs:
+            fk = miller_loop(P, Qa)
+            f = fk if f is None else tower.fq12_mul(f, fk)
+        return f
+
+    def cat(leaves):
+        return jnp.concatenate([jnp.asarray(c) for c in leaves], axis=0)
+
+    P = jax.tree_util.tree_map(lambda *cs: cat(cs), *[p for p, _ in pairs])
+    Qa = jax.tree_util.tree_map(lambda *cs: cat(cs), *[q for _, q in pairs])
+    f_all = miller_loop(P, Qa)
+    batch = jnp.shape(pairs[0][0][0])[0]
+    parts = [
+        jax.tree_util.tree_map(lambda c: c[i * batch : (i + 1) * batch], f_all)
+        for i in range(len(pairs))
+    ]
+    f = parts[0]
+    for fk in parts[1:]:
+        f = tower.fq12_mul(f, fk)
     return f
 
 
@@ -308,6 +363,10 @@ def final_exponentiation_fast(f):
     64-bit x-powers ≈ 5× cheaper than the plain 1270-bit scan).  Use
     `final_exponentiation` when the exact pairing VALUE matters.
     """
+    if _use_fused():
+        from hbbft_tpu.ops import pairing_fused
+
+        return pairing_fused.final_exp_fast(f)
     # easy part: f^((Q⁶−1)(Q²+1)) → cyclotomic subgroup
     m = tower.fq12_mul(tower.fq12_conj(f), tower.fq12_inv(f))
     m = tower.fq12_mul(tower.fq12_frobenius_n(m, 2), m)

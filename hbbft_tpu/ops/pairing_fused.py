@@ -1,0 +1,640 @@
+"""Fused Pallas kernels for the pairing verification graph.
+
+The measured cost model on a v5e (PERF.md "per-call overhead"): every
+Pallas dispatch carries ~100 µs of fixed overhead (launch + (50, lanes)
+relayouts at the kernel boundary), while the arithmetic inside runs at
+>200 M Fq-muls/s.  The unfused verification graph makes ~550 sequential
+stacked-multiply dispatches (4 per Miller doubling × 63, ~2 per final-exp
+x-chain bit × 4 chains, plus glue), so at protocol batch sizes (256–1024
+lanes) the graph is ~90% launch overhead — and flat in batch size.
+
+This module collapses whole formula blocks into single kernels, keeping
+every intermediate in VMEM and the loop state in **limbs-first packed
+layout** ((rows, NLIMBS, lanes)) across the entire scan, so the per-call
+boundary transposes disappear too:
+
+* ``_step_call``      — one Miller double-step (f ← f²·l(R), R ← 2R):
+                        ~121 Fq products that previously took 4 dispatches
+                        plus XLA recombination glue between them.
+* ``_cyclo_run_call`` — k consecutive Granger–Scott cyclotomic squarings
+                        via an in-kernel fori_loop: one dispatch per
+                        zero-run of the x-chain instead of one (or two)
+                        per bit.
+* ``_mul12_call``     — a full fq12 multiply (54 products, one dispatch);
+                        used at the set bits of the x-chain and for the
+                        final-exp recombination products.
+
+The kernel bodies re-derive the tower formulas (Karatsuba fq2, Toom-ish
+fq6, complex fq12 squaring, sparse line multiply) from the same algebra
+as ops/tower.py; equivalence is enforced by golden tests against the
+unfused path (tests/test_pairing_fused.py) over random points.
+
+Reference analogue: the `pairing` crate's Miller loop / final
+exponentiation under `threshold_crypto` (SURVEY.md §2.2) — restructured
+so one TPU kernel launch does the work its CPU code spreads over a
+function call tree.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from hbbft_tpu.ops import fq
+from hbbft_tpu.ops.fq_pallas import (
+    _CONV_MODE,
+    _CONV_PAD,
+    _FOLD_T,
+    _SUB,
+    _carry_cols,
+    _mul_core,
+)
+
+TILE = int(os.environ.get("HBBFT_TPU_FUSED_TILE", "512"))
+
+# Packed-state row order for an fq12 element: f[j][i][k] — Fq6 coeff j,
+# fq2 coeff i, Fq component k.
+F12_ROWS = 12
+# Miller scan state: fq12 f (12 rows) + Jacobian G2 R = X, Y, Z (6 rows).
+STEP_ROWS = F12_ROWS + 6
+
+
+def _scratch():
+    if _CONV_MODE == "scratch":
+        return [pltpu.VMEM((fq.CONV, TILE), fq.DTYPE)]
+    if _CONV_MODE == "grouped":
+        return [pltpu.VMEM((_SUB, _CONV_PAD, TILE), fq.DTYPE)]
+    return []
+
+
+# ---------------------------------------------------------------------------
+# Kernel-local tower algebra on (NLIMBS, T) limb columns.
+#
+# Every helper takes/returns possibly-lazy values; ``m`` (the only place
+# magnitudes are consumed multiplicatively) renormalizes its operands, so
+# linear combinations with small coefficients (≤ 8 here) are always safe
+# inside the float32 exact envelope (see ops/fq.py domain note).
+# ---------------------------------------------------------------------------
+
+
+def _algebra(fold_t, acc_ref):
+    def m(a, b):  # Fq product, carried output
+        return _mul_core(_carry_cols(a), _carry_cols(b), fold_t, acc_ref)
+
+    def m2(a, b):  # fq2 Karatsuba: 3 Fq products
+        t0 = m(a[0], b[0])
+        t1 = m(a[1], b[1])
+        t2 = m(a[0] + a[1], b[0] + b[1])
+        return (t0 - t1, t2 - t0 - t1)
+
+    def sq2(a):  # fq2 squaring: (a0+a1)(a0−a1), 2·a0a1
+        t0 = m(a[0] + a[1], a[0] - a[1])
+        t1 = m(a[0], a[1])
+        return (t0, t1 + t1)
+
+    return m, m2, sq2
+
+
+def _add2(a, b):
+    return (a[0] + b[0], a[1] + b[1])
+
+
+def _sub2(a, b):
+    return (a[0] - b[0], a[1] - b[1])
+
+
+def _xi2(a):  # × ξ = 1 + u
+    return (a[0] - a[1], a[0] + a[1])
+
+
+def _add6(a, b):
+    return tuple(_add2(x, y) for x, y in zip(a, b))
+
+
+def _sub6(a, b):
+    return tuple(_sub2(x, y) for x, y in zip(a, b))
+
+
+def _mul_by_v(a):  # fq6 × v
+    return (_xi2(a[2]), a[0], a[1])
+
+
+def _m6(m2, a, b):
+    """fq6 product: 6 fq2 products + ξ recombination (tower.fq6_mul)."""
+    t0, t1, t2 = m2(a[0], b[0]), m2(a[1], b[1]), m2(a[2], b[2])
+    m12 = m2(_add2(a[1], a[2]), _add2(b[1], b[2]))
+    m01 = m2(_add2(a[0], a[1]), _add2(b[0], b[1]))
+    m02 = m2(_add2(a[0], a[2]), _add2(b[0], b[2]))
+    c0 = _add2(t0, _xi2(_sub2(m12, _add2(t1, t2))))
+    c1 = _add2(_sub2(m01, _add2(t0, t1)), _xi2(t2))
+    c2 = _add2(_sub2(m02, _add2(t0, t2)), t1)
+    return (c0, c1, c2)
+
+
+def _sqr12(m2, f):
+    """Complex fq12 squaring (tower.fq12_sqr): c1 = 2·a0a1,
+    c0 = (a0+a1)(a0+v·a1) − t − v·t with t = a0·a1."""
+    a0, a1 = f
+    t = _m6(m2, a0, a1)
+    u = _m6(m2, _add6(a0, a1), _add6(a0, _mul_by_v(a1)))
+    c0 = _sub6(u, _add6(t, _mul_by_v(t)))
+    c1 = _add6(t, t)
+    return (c0, c1)
+
+
+def _mul12(m2, a, b):
+    """Full fq12 product: Karatsuba over fq6 (tower.fq12_mul)."""
+    a0, a1 = a
+    b0, b1 = b
+    t0 = _m6(m2, a0, b0)
+    t1 = _m6(m2, a1, b1)
+    mid = _m6(m2, _add6(a0, a1), _add6(b0, b1))
+    c0 = _add6(t0, _mul_by_v(t1))
+    c1 = _sub6(mid, _add6(t0, t1))
+    return (c0, c1)
+
+
+def _mul_line(m2, f, line):
+    """f × sparse line (l0, l4, l5) — tower.fq12_mul_line."""
+    l0, l4, l5 = line
+    f0, f1 = f
+    a0, a1, a2 = f0
+    b0, b1, b2 = f1
+    t0 = (m2(a0, l0), m2(a1, l0), m2(a2, l0))
+    t1 = (
+        _xi2(_add2(m2(b1, l5), m2(b2, l4))),
+        _add2(m2(b0, l4), _xi2(m2(b2, l5))),
+        _add2(m2(b0, l5), m2(b1, l4)),
+    )
+    mid = _m6(m2, _add6(f0, f1), (l0, l4, l5))
+    c0 = _add6(t0, _mul_by_v(t1))
+    c1 = _sub6(mid, _add6(t0, t1))
+    return (c0, c1)
+
+
+# -- packed-state <-> tower-tuple conversion (kernel side) -------------------
+
+
+def _read_f12(ref_or_arr, base=0):
+    g = lambda k: ref_or_arr[base + k]  # noqa: E731
+    return (
+        ((g(0), g(1)), (g(2), g(3)), (g(4), g(5))),
+        ((g(6), g(7)), (g(8), g(9)), (g(10), g(11))),
+    )
+
+
+def _write_f12(ref, f, base=0):
+    vals = [c for six in f for two in six for c in two]
+    for k, v in enumerate(vals):
+        ref[base + k] = v
+
+
+# ---------------------------------------------------------------------------
+# Kernel 1: Miller double-step — f ← f²·l(R), R ← 2R in ONE launch.
+# ---------------------------------------------------------------------------
+
+
+def _double_step_math(m, m2, sq2, X, Y, Z, xP, yP):
+    """The fused doubling formulas (pairing._miller_double_step algebra)."""
+    XX = sq2(X)
+    YY = sq2(Y)
+    ZZ = sq2(Z)
+    YZ = m2(Y, Z)
+    E = (XX[0] + XX[0] + XX[0], XX[1] + XX[1] + XX[1])  # 3X²
+    XpYY = _add2(X, YY)
+    XXX = m2(XX, X)
+    XXZZ = m2(XX, ZZ)
+    YZ3 = m2(YZ, ZZ)  # Y·Z³
+    C = sq2(YY)  # Y⁴
+    T = sq2(XpYY)
+    Fv = sq2(E)
+    D = _sub2(_sub2(T, XX), C)
+    D = _add2(D, D)  # 2((X+Y²)² − X² − Y⁴)
+    X3 = _sub2(Fv, _add2(D, D))
+    C4 = _add2(_add2(C, C), _add2(C, C))
+    C8 = _add2(C4, C4)
+
+    # Line l = 2YZ³·ξ·y_P + (3X³ − 2Y²)·w³ − 3X²Z²·x_P·w⁵
+    c1a1 = _sub2(
+        (XXX[0] + XXX[0] + XXX[0], XXX[1] + XXX[1] + XXX[1]),
+        _add2(YY, YY),
+    )
+    u = _xi2(_add2(YZ3, YZ3))
+    v = (XXZZ[0] + XXZZ[0] + XXZZ[0], XXZZ[1] + XXZZ[1] + XXZZ[1])
+    c0a0 = (m(u[0], yP), m(u[1], yP))
+    c1a2 = (-m(v[0], xP), -m(v[1], xP))
+
+    EDX3 = m2(E, _sub2(D, X3))
+    Y3 = _sub2(EDX3, C8)
+    Z3 = _add2(YZ, YZ)
+
+    return (c0a0, c1a1, c1a2), X3, Y3, Z3
+
+
+def _step_kernel(state_ref, pq_ref, fold_ref, out_ref, acc_ref=None):
+    m, m2, sq2 = _algebra(fold_ref[:], acc_ref)
+    f = _read_f12(state_ref)
+    X = (state_ref[12], state_ref[13])
+    Y = (state_ref[14], state_ref[15])
+    Z = (state_ref[16], state_ref[17])
+    xP, yP = pq_ref[0], pq_ref[1]
+
+    f2 = _sqr12(m2, f)
+    line, X3, Y3, Z3 = _double_step_math(m, m2, sq2, X, Y, Z, xP, yP)
+    f_new = _mul_line(m2, f2, line)
+
+    _write_f12(out_ref, f_new)
+    out_ref[12], out_ref[13] = X3[0], X3[1]
+    out_ref[14], out_ref[15] = Y3[0], Y3[1]
+    out_ref[16], out_ref[17] = Z3[0], Z3[1]
+
+
+@functools.lru_cache(maxsize=None)
+def _step_call(n_tiles: int, interpret: bool):
+    return pl.pallas_call(
+        _step_kernel,
+        out_shape=jax.ShapeDtypeStruct(
+            (STEP_ROWS, fq.NLIMBS, n_tiles * TILE), fq.DTYPE
+        ),
+        grid=(n_tiles,),
+        in_specs=[
+            pl.BlockSpec((STEP_ROWS, fq.NLIMBS, TILE), lambda i: (0, 0, i)),
+            pl.BlockSpec((2, fq.NLIMBS, TILE), lambda i: (0, 0, i)),
+            pl.BlockSpec(
+                (fq.NLIMBS, fq.CONV - fq.FOLD_FROM), lambda i: (0, 0)
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (STEP_ROWS, fq.NLIMBS, TILE), lambda i: (0, 0, i)
+        ),
+        scratch_shapes=_scratch(),
+        interpret=interpret,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Kernel 2: k cyclotomic squarings in one launch (fori_loop inside).
+# ---------------------------------------------------------------------------
+
+
+def _cyclo_sqr_math(m2, sq2, f):
+    """Granger–Scott compressed squaring (tower.fq12_cyclo_sqr algebra).
+
+    Fq4 pairs (x, y) ∈ {(a0, b1), (a1, b2), (a2, b0)}; per pair the three
+    squares x², y², (x+y)² give 2xy; recombine with ξ mixing.
+    """
+    (a0, a1, a2), (b0, b1, b2) = f
+
+    def pair(x, y):
+        xs = sq2(x)
+        ys = sq2(y)
+        ss = sq2(_add2(x, y))
+        xy = _sub2(_sub2(ss, xs), ys)
+        return xs, ys, xy
+
+    x0s, y0s, xy0 = pair(a0, b1)
+    x1s, y1s, xy1 = pair(a1, b2)
+    x2s, y2s, xy2 = pair(a2, b0)
+
+    def three(t):
+        return (t[0] + t[0] + t[0], t[1] + t[1] + t[1])
+
+    def two(t):
+        return (t[0] + t[0], t[1] + t[1])
+
+    s_a0 = _sub2(three(_add2(x0s, _xi2(y0s))), two(a0))
+    s_b1 = _add2(three(xy0), two(b1))
+    s_a2 = _sub2(three(_add2(x1s, _xi2(y1s))), two(a2))
+    s_b0 = _add2(_xi2(three(xy1)), two(b0))
+    s_a1 = _sub2(three(_add2(_xi2(x2s), y2s)), two(a1))
+    s_b2 = _add2(three(xy2), two(b2))
+    return ((s_a0, s_a1, s_a2), (s_b0, s_b1, s_b2))
+
+
+def _reduce_cols(x, fold_t):
+    """fq.reduce_small in limbs-first layout: carry → fold → carry.
+
+    The fold is NOT optional here: limbs ≥ FOLD_FROM (including the top
+    limb, which the carry passes deliberately never split) must be
+    redistributed mod Q, otherwise linear terms that pass an input limb
+    straight to an output (the ±2·aᵢ terms of the cyclotomic squaring)
+    double the top limb every iteration — exponential growth that
+    overflows float32 after ~25 chained squarings."""
+    x = _carry_cols(x)
+    ff = fq.FOLD_FROM
+    nhi = fq.NLIMBS - ff
+    t = x.shape[1]
+    x = jnp.concatenate(
+        [x[:ff], jnp.zeros((nhi, t), dtype=fq.DTYPE)], axis=0
+    ) + jnp.dot(fold_t[:, :nhi], x[ff:], preferred_element_type=fq.DTYPE)
+    return _carry_cols(x)
+
+
+def _cyclo_run_kernel(k: int, state_ref, fold_ref, out_ref, acc_ref=None):
+    fold_t = fold_ref[:]
+    m, m2, sq2 = _algebra(fold_t, acc_ref)
+    f0 = _read_f12(state_ref)
+    flat0 = [c for six in f0 for two in six for c in two]
+
+    def body(_, flat):
+        f = (
+            ((flat[0], flat[1]), (flat[2], flat[3]), (flat[4], flat[5])),
+            ((flat[6], flat[7]), (flat[8], flat[9]), (flat[10], flat[11])),
+        )
+        out = _cyclo_sqr_math(m2, sq2, f)
+        return [
+            _reduce_cols(c, fold_t) for six in out for two in six for c in two
+        ]
+
+    flat = jax.lax.fori_loop(0, k, body, flat0)
+    for i, c in enumerate(flat):
+        out_ref[i] = c
+
+
+@functools.lru_cache(maxsize=None)
+def _cyclo_run_call(k: int, n_tiles: int, interpret: bool):
+    return pl.pallas_call(
+        functools.partial(_cyclo_run_kernel, k),
+        out_shape=jax.ShapeDtypeStruct(
+            (F12_ROWS, fq.NLIMBS, n_tiles * TILE), fq.DTYPE
+        ),
+        grid=(n_tiles,),
+        in_specs=[
+            pl.BlockSpec((F12_ROWS, fq.NLIMBS, TILE), lambda i: (0, 0, i)),
+            pl.BlockSpec(
+                (fq.NLIMBS, fq.CONV - fq.FOLD_FROM), lambda i: (0, 0)
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (F12_ROWS, fq.NLIMBS, TILE), lambda i: (0, 0, i)
+        ),
+        scratch_shapes=_scratch(),
+        interpret=interpret,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Kernel 3: full fq12 multiply.
+# ---------------------------------------------------------------------------
+
+
+def _mul12_kernel(a_ref, b_ref, fold_ref, out_ref, acc_ref=None):
+    m, m2, sq2 = _algebra(fold_ref[:], acc_ref)
+    out = _mul12(m2, _read_f12(a_ref), _read_f12(b_ref))
+    _write_f12(out_ref, out)
+
+
+@functools.lru_cache(maxsize=None)
+def _mul12_call(n_tiles: int, interpret: bool):
+    spec = pl.BlockSpec((F12_ROWS, fq.NLIMBS, TILE), lambda i: (0, 0, i))
+    return pl.pallas_call(
+        _mul12_kernel,
+        out_shape=jax.ShapeDtypeStruct(
+            (F12_ROWS, fq.NLIMBS, n_tiles * TILE), fq.DTYPE
+        ),
+        grid=(n_tiles,),
+        in_specs=[
+            spec,
+            spec,
+            pl.BlockSpec(
+                (fq.NLIMBS, fq.CONV - fq.FOLD_FROM), lambda i: (0, 0)
+            ),
+        ],
+        out_specs=spec,
+        scratch_shapes=_scratch(),
+        interpret=interpret,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Host-side packing: tower pytrees ((..., NLIMBS) leaves) <-> packed
+# (rows, NLIMBS, lanes) arrays.  Done ONCE per scan, not per call.
+# ---------------------------------------------------------------------------
+
+
+def _leaves_f12(f):
+    return [c for six in f for two in six for c in two]
+
+
+def pack_rows(leaves, lanes):
+    """[(..., NLIMBS) leaves] → (rows, NLIMBS, lanes_padded)."""
+    n_tiles = max(1, -(-lanes // TILE))
+    pad = n_tiles * TILE - lanes
+    stacked = jnp.stack(
+        [
+            jnp.asarray(leaf, fq.DTYPE).reshape(lanes, fq.NLIMBS).T
+            for leaf in leaves
+        ]
+    )  # (rows, NLIMBS, lanes)
+    if pad:
+        stacked = jnp.pad(stacked, ((0, 0), (0, 0), (0, pad)))
+    return stacked
+
+
+def unpack_f12(packed, lanes):
+    """(≥12, NLIMBS, lanes_padded) → fq12 tuple of (lanes, NLIMBS)."""
+    g = lambda k: packed[k, :, :lanes].T  # noqa: E731
+    return (
+        ((g(0), g(1)), (g(2), g(3)), (g(4), g(5))),
+        ((g(6), g(7)), (g(8), g(9)), (g(10), g(11))),
+    )
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+# ---------------------------------------------------------------------------
+# Fused Miller loop and final exponentiation (drop-in for pairing.py).
+# ---------------------------------------------------------------------------
+
+
+def miller_loop(P, Qa):
+    """Batched f_{|x|,Q}(P) with one kernel launch per doubling step.
+
+    Same contract as pairing.miller_loop; the addition step (5 of 63
+    iterations) runs on the unfused XLA path behind a lax.cond with
+    pack/unpack at the branch boundary.
+    """
+    from hbbft_tpu.crypto.bls381 import BLS_X_IS_NEG
+    from hbbft_tpu.ops import pairing, tower
+
+    xP, yP, infP = P
+    xQ, yQ, infQ = Qa
+    out_shape = jnp.asarray(xP).shape[:-1]
+    lanes = int(np.prod(out_shape)) if out_shape else 1
+    n_tiles = max(1, -(-lanes // TILE))
+    interpret = _interpret()
+
+    # Work on a FLAT batch throughout (pack/unpack and the add-step
+    # branch all assume rank 1); restore the caller's shape at the end.
+    def flat_fq(c):
+        return jnp.asarray(c).reshape(lanes, fq.NLIMBS)
+
+    xP, yP = flat_fq(xP), flat_fq(yP)
+    xQ = (flat_fq(xQ[0]), flat_fq(xQ[1]))
+    yQ = (flat_fq(yQ[0]), flat_fq(yQ[1]))
+    infP = jnp.asarray(infP).reshape(lanes)
+    infQ = jnp.asarray(infQ).reshape(lanes)
+    Qa = (xQ, yQ, infQ)
+    batch_shape = (lanes,)
+
+    one2 = tower.fq2_broadcast(tower.FQ2_ONE, batch_shape)
+    f1 = tower.fq12_broadcast_one(batch_shape)
+    state = pack_rows(
+        _leaves_f12(f1) + [xQ[0], xQ[1], yQ[0], yQ[1], one2[0], one2[1]],
+        lanes,
+    )
+    pq = pack_rows([xP, yP], lanes)
+    fold = jnp.asarray(_FOLD_T)
+    Qj = (xQ, yQ, one2, jnp.zeros(batch_shape, dtype=bool))
+
+    step = _step_call(n_tiles, interpret)
+
+    def unpack_state(s):
+        f = unpack_f12(s, lanes)
+        g = lambda k: s[k, :, :lanes].T  # noqa: E731
+        R = (
+            (g(12), g(13)),
+            (g(14), g(15)),
+            (g(16), g(17)),
+            jnp.zeros(batch_shape, dtype=bool),
+        )
+        return f, R
+
+    def repack_state(f, R):
+        X, Y, Z, _ = R
+        return pack_rows(
+            _leaves_f12(f) + [X[0], X[1], Y[0], Y[1], Z[0], Z[1]], lanes
+        )
+
+    def add_branch(s):
+        f, R = unpack_state(s)
+        f, R = pairing._miller_add_step(f, R, Qa, Qj, xP, yP)
+        return repack_state(f, R)
+
+    bits = jnp.asarray(pairing._X_BITS, dtype=jnp.bool_)
+
+    def body(s, bit):
+        s = step(s, pq, fold)
+        s = jax.lax.cond(bit, add_branch, lambda t: t, s)
+        return s, None
+
+    state, _ = jax.lax.scan(body, state, bits)
+    f, _ = unpack_state(state)
+
+    if BLS_X_IS_NEG:
+        f = tower.fq12_conj(f)
+
+    neutral = infP | infQ
+    f = tower.fq12_select(neutral, tower.fq12_broadcast_one(batch_shape), f)
+    # Restore the caller's batch shape (flattened on entry).
+    return jax.tree_util.tree_map(
+        lambda c: c.reshape(tuple(out_shape) + (fq.NLIMBS,)), f
+    )
+
+
+def _segments(exponent: int):
+    """x-chain plan: [(n_squarings, multiply_after?)] covering the bits
+    of ``exponent`` after the implicit MSB."""
+    bits = bin(exponent)[3:]
+    plan = []
+    run = 0
+    for b in bits:
+        run += 1
+        if b == "1":
+            plan.append((run, True))
+            run = 0
+    if run:
+        plan.append((run, False))
+    return plan
+
+
+def cyclo_pow(packed_m, exponent: int, n_tiles: int):
+    """m^exponent for cyclotomic packed m — one launch per zero-run plus
+    one fq12-multiply launch per set bit (drop-in for the scan in
+    tower.fq12_cyclo_pow_segmented, minus ~10× the dispatches)."""
+    interpret = _interpret()
+    fold = jnp.asarray(_FOLD_T)
+    acc = packed_m
+    for run, mult in _segments(exponent):
+        acc = _cyclo_run_call(run, n_tiles, interpret)(acc, fold)
+        if mult:
+            acc = _mul12_call(n_tiles, interpret)(acc, packed_m, fold)
+    return acc
+
+
+def fused_mul12(a_packed, b_packed, n_tiles: int):
+    return _mul12_call(n_tiles, _interpret())(
+        a_packed, b_packed, jnp.asarray(_FOLD_T)
+    )
+
+
+def _conj_packed(p):
+    """Packed fq12 conjugate: negate the c1 rows (6..11)."""
+    mask = np.ones((F12_ROWS, 1, 1), dtype=fq.NP_DTYPE)
+    mask[6:] = -1
+    return p * jnp.asarray(mask)
+
+
+def final_exp_fast(f):
+    """f^{3·(Q¹²−1)/R} — pairing.final_exponentiation_fast with the hard
+    part running on the fused kernels.
+
+    Easy part stays on the tower path (its Fermat inverse is already one
+    kernel via fq_pallas.pow_fixed); the four x-power chains then run as
+    packed cyclo-run + fq12-mul launches (~10 dispatches per chain
+    instead of ~130), and the final Frobenius recombination returns to
+    the tower path (3 small constant multiplies).
+    """
+    from hbbft_tpu.crypto.bls381 import BLS_X, BLS_X_IS_NEG
+    from hbbft_tpu.ops import tower
+
+    out_shape = jnp.asarray(f[0][0][0]).shape[:-1]
+    lanes = int(np.prod(out_shape)) if out_shape else 1
+    n_tiles = max(1, -(-lanes // TILE))
+    interpret = _interpret()
+
+    # Easy part: m = f^((Q⁶−1)(Q²+1)) — cyclotomic afterwards.
+    m = tower.fq12_mul(tower.fq12_conj(f), tower.fq12_inv(f))
+    m = tower.fq12_mul(tower.fq12_frobenius_n(m, 2), m)
+
+    pm = pack_rows(_leaves_f12(m), lanes)
+
+    def pow_x(p):
+        out = cyclo_pow(p, BLS_X, n_tiles)
+        return _conj_packed(out) if BLS_X_IS_NEG else out
+
+    def mul(a, b):
+        return fused_mul12(a, b, n_tiles)
+
+    a = pow_x(pm)  # m^x
+    b = mul(a, _conj_packed(pm))  # m^(x−1)
+    c = pow_x(b)  # m^(x²−x)
+    y3 = mul(c, _conj_packed(b))  # m^((x−1)²)
+    y2 = pow_x(y3)
+    y1 = mul(pow_x(y2), _conj_packed(y3))
+    m3 = mul(
+        _cyclo_run_call(1, n_tiles, interpret)(pm, jnp.asarray(_FOLD_T)), pm
+    )  # m³
+    y0 = mul(pow_x(y1), m3)
+
+    # Frobenius recombination on the tower path (3 constant multiplies).
+    u0 = unpack_f12(y0, lanes)
+    u1 = unpack_f12(y1, lanes)
+    u2 = unpack_f12(y2, lanes)
+    u3 = unpack_f12(y3, lanes)
+    out = tower.fq12_mul(u0, tower.fq12_frobenius(u1))
+    out = tower.fq12_mul(out, tower.fq12_frobenius_n(u2, 2))
+    out = tower.fq12_mul(out, tower.fq12_frobenius_n(u3, 3))
+    # Restore the caller's batch shape (pack/unpack flattens it).
+    return jax.tree_util.tree_map(
+        lambda c: jnp.asarray(c).reshape(tuple(out_shape) + (fq.NLIMBS,)), out
+    )

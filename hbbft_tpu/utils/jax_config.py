@@ -40,6 +40,28 @@ def enable_compile_cache(cache_dir: str | None = None) -> None:
     """
     import jax
 
+    # XLA:CPU persists AOT executables whose recorded target features
+    # include tuning pseudo-features (+prefer-no-scatter/-gather) that
+    # fail the loader's host-compatibility check EVEN ON THE SAME HOST —
+    # observed as warn-then-SIGILL or a hard abort inside
+    # compilation_cache.get_executable_and_time (two pytest runs died
+    # there 2026-07-30).  The cache is therefore TPU-only; CPU runs
+    # (tests, the dryrun child) always compile fresh.  Override with
+    # HBBFT_TPU_FORCE_CPU_CACHE=1 for local experiments.
+    # Key off the PRIMARY platform: the ambient TPU session registers
+    # "axon,cpu" (cpu as fallback) and must keep the cache; a forced-CPU
+    # child ("cpu") must not.  An EMPTY string (auto-detection) keeps the
+    # cache: every CPU-forced context in this project sets the platform
+    # explicitly (conftest, dryrun child, bench re-exec), and probing
+    # jax.default_backend() here would initialize — and possibly hang on a
+    # dead tunnel — the backend at import time.
+    plats = jax.config.jax_platforms or os.environ.get("JAX_PLATFORMS", "")
+    primary = plats.split(",")[0].strip().lower()
+    if primary not in ("tpu", "axon", "") and not os.environ.get(
+        "HBBFT_TPU_FORCE_CPU_CACHE"
+    ):
+        return
+
     if cache_dir is None:
         repo = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
         cache_dir = os.path.join(repo, f".jax_cache.{_host_tag()}")

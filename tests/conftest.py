@@ -27,8 +27,11 @@ jax.config.update("jax_platforms", "cpu")
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO)
 
-# Persistent compilation cache: the pairing graphs are compile-heavy on
-# CPU; cache across test runs and rounds.
+# NOTE: enable_compile_cache() is a deliberate no-op on the CPU platform —
+# XLA:CPU's AOT cache entries fail the loader's host-feature check even on
+# the host that wrote them (warn-then-SIGILL / hard abort; two pytest runs
+# died that way 2026-07-30, see utils/jax_config.py).  The suite therefore
+# recompiles per run; keep per-test graph sizes small.
 from hbbft_tpu.utils.jax_config import enable_compile_cache  # noqa: E402
 
 enable_compile_cache()

@@ -53,6 +53,25 @@ def test_vmap(rng):
             assert fq.to_int(out[i, j]) == (xs[i][j] * ys[i][j]) % Q
 
 
+def test_pow_fixed_kernel(rng):
+    """The in-kernel square-and-multiply chain (fori_loop over a
+    scalar-prefetched bit schedule) matches Python pow, including the
+    Fermat-inverse exponent that dominates final exponentiation."""
+    xs = [rng.randrange(1, Q) for _ in range(5)] + [1, Q - 1]
+    a = fq.from_ints(xs)
+    for e in (1, 2, 3, 0b101101, Q - 2):
+        got = fq.to_ints(np.asarray(fq_pallas.pow_fixed(a, e, interpret=True)))
+        assert got == [pow(x, e, Q) for x in xs], hex(e)
+
+
+def test_pow_fixed_kernel_lazy_input(rng):
+    xs = [rng.randrange(Q) for _ in range(4)]
+    ys = [rng.randrange(Q) for _ in range(4)]
+    lazy = fq.add(fq.from_ints(xs), fq.from_ints(ys))
+    got = fq.to_ints(np.asarray(fq_pallas.pow_fixed(lazy, 7, interpret=True)))
+    assert got == [pow(x + y, 7, Q) for x, y in zip(xs, ys)]
+
+
 def test_all_conv_modes_match_golden(rng, monkeypatch):
     """Every convolution strategy (concat / scratch / grouped) computes the
     same product — the modes exist only for on-chip A/B timing."""

@@ -1,0 +1,164 @@
+"""Golden tests: fused whole-block pairing kernels vs the unfused path.
+
+All run in Pallas interpret mode on CPU (the conftest forces the host
+platform, so pairing's dispatch switch keeps the unfused path as the
+reference while the fused module is called directly).
+
+Cost control (the CPU compile cache is deliberately off — see conftest):
+the kernels run with a reduced TILE so interpret-mode work shrinks 4×,
+and the expensive unfused reference computations are module-scoped
+fixtures shared across tests.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from hbbft_tpu.crypto import bls381 as gold
+from hbbft_tpu.crypto.field import R as SUBR
+from hbbft_tpu.ops import pairing, pairing_fused, tower
+
+
+@pytest.fixture(scope="module", autouse=True)
+def small_tile():
+    """Shrink the fused kernels' lane tile for interpret-mode speed."""
+    calls = (
+        pairing_fused._step_call,
+        pairing_fused._cyclo_run_call,
+        pairing_fused._mul12_call,
+    )
+    old = pairing_fused.TILE
+    pairing_fused.TILE = 128
+    for c in calls:
+        c.cache_clear()
+    yield
+    pairing_fused.TILE = old
+    for c in calls:
+        c.cache_clear()
+
+
+@pytest.fixture(scope="module")
+def rng():
+    return random.Random(77)
+
+
+@pytest.fixture(scope="module")
+def points(rng):
+    """Batch of 3: two random multiples and one generator pair."""
+    quads = []
+    for a in (rng.randrange(1, SUBR), rng.randrange(1, SUBR), 1):
+        quads.append(
+            (
+                gold.ec_mul(gold.FQ, a, gold.G1_GEN),
+                gold.ec_mul(gold.FQ2, (a * 7 + 1) % SUBR, gold.G2_GEN),
+            )
+        )
+    P = pairing.g1_affine_to_device([q[0] for q in quads])
+    Qa = pairing.g2_affine_to_device([q[1] for q in quads])
+    return P, Qa
+
+
+@pytest.fixture(scope="module")
+def miller_want(points):
+    """Unfused reference Miller value (compiled once per run)."""
+    P, Qa = points
+    return pairing.miller_loop(P, Qa)
+
+
+def test_mul12_kernel_matches_tower(rng):
+    def rand_f12():
+        return tower.fq12_stack(
+            [
+                tuple(
+                    tuple(
+                        (rng.randrange(gold.Q), rng.randrange(gold.Q))
+                        for _ in range(3)
+                    )
+                    for _ in range(2)
+                )
+            ]
+        )
+
+    a, b = rand_f12(), rand_f12()
+    want = tower.fq12_to_ints(tower.fq12_mul(a, b), 0)
+    pa = pairing_fused.pack_rows(pairing_fused._leaves_f12(a), 1)
+    pb = pairing_fused.pack_rows(pairing_fused._leaves_f12(b), 1)
+    out = pairing_fused.fused_mul12(pa, pb, 1)
+    got = tower.fq12_to_ints(pairing_fused.unpack_f12(out, 1), 0)
+    assert got == want
+
+
+def test_cyclo_run_kernel_matches_tower(points, miller_want):
+    # A genuinely cyclotomic element: the easy part of a Miller value.
+    m = tower.fq12_mul(
+        tower.fq12_conj(miller_want), tower.fq12_inv(miller_want)
+    )
+    m = tower.fq12_mul(tower.fq12_frobenius_n(m, 2), m)
+
+    want = m
+    for _ in range(3):
+        want = tower.fq12_cyclo_sqr(want)
+
+    lanes = 3
+    pm = pairing_fused.pack_rows(pairing_fused._leaves_f12(m), lanes)
+    out = pairing_fused._cyclo_run_call(3, 1, True)(
+        pm, jnp.asarray(pairing_fused._FOLD_T)
+    )
+    got = pairing_fused.unpack_f12(out, lanes)
+    for i in range(lanes):
+        assert tower.fq12_to_ints(got, i) == tower.fq12_to_ints(want, i)
+
+
+def test_fused_miller_loop_matches_unfused(points, miller_want):
+    P, Qa = points
+    got = pairing_fused.miller_loop(P, Qa)
+    for i in range(3):
+        assert tower.fq12_to_ints(got, i) == tower.fq12_to_ints(
+            miller_want, i
+        )
+
+
+def test_fused_final_exp_matches_unfused(miller_want):
+    want = pairing.final_exponentiation_fast(miller_want)
+    got = pairing_fused.final_exp_fast(miller_want)
+    for i in range(3):
+        assert tower.fq12_to_ints(got, i) == tower.fq12_to_ints(want, i)
+
+
+def test_fused_miller_loop_rank2_batch(points, miller_want):
+    """Multi-dim batch shapes flatten through pack/unpack and come back."""
+    P, Qa = points
+    # Build a (2, 2) batch by repeating lanes 0 and 1.
+    take = lambda t, idx: jax.tree_util.tree_map(  # noqa: E731
+        lambda c: jnp.asarray(c)[idx], t
+    )
+    idx = jnp.asarray([0, 1, 1, 0])
+    P4, Q4 = take(P, idx), take(Qa, idx)
+    r2 = lambda t: jax.tree_util.tree_map(  # noqa: E731
+        lambda c: c.reshape((2, 2) + c.shape[1:]), t
+    )
+    got = pairing_fused.miller_loop(r2(P4), r2(Q4))
+    assert jnp.asarray(got[0][0][0]).shape[:-1] == (2, 2)
+    flat = jax.tree_util.tree_map(
+        lambda c: c.reshape((4,) + c.shape[2:]), got
+    )
+    for i, j in ((0, 0), (1, 1), (2, 1), (3, 0)):
+        assert tower.fq12_to_ints(flat, i) == tower.fq12_to_ints(
+            miller_want, j
+        )
+
+
+def test_fused_verification_end_to_end():
+    """FE_fused(ML_fused(−G1, aG2)·ML_fused(aG1, G2)) == 1."""
+    args = pairing.example_verify_batch(2, distinct=2)
+    f = tower.fq12_mul(
+        pairing_fused.miller_loop(args[0], args[1]),
+        pairing_fused.miller_loop(args[2], args[3]),
+    )
+    out = pairing_fused.final_exp_fast(f)
+    for i in range(2):
+        assert pairing.is_one_host(out, i)
