@@ -472,9 +472,10 @@ def main() -> None:
     if os.environ.get("BENCH_N100", "1") != "0":
         extra.append(("n100", bench_epochs_n100))
 
-    from hbbft_tpu.utils.jax_config import enable_compile_cache
+    from hbbft_tpu.utils.jax_config import enable_compile_cache, raise_stack_limit
 
     enable_compile_cache()
+    raise_stack_limit()  # XLA:CPU LLVM recursion vs the 8 MB default stack
 
     import jax
 

@@ -165,7 +165,19 @@ def scalar_mul(F, bits: jnp.ndarray, P):
     """MSB-first ladder: bits shape (..., SCALAR_BITS) over batch shape.
 
     Scalars must be pre-screened by `safe_scalar` (< 2^254, no ±1 prefix).
+
+    On TPU the whole ladder runs inside ONE Pallas kernel
+    (ops/curve_fused.py) — the scan form below dispatches ~8 stacked
+    multiplies per bit, which at ~100 µs fixed cost per call makes the
+    254-bit ladder >95% launch overhead (PERF.md).  The scan path stays
+    as the golden cross-check (HBBFT_TPU_NO_FUSED=1).
     """
+    if jnp.ndim(bits) == 2:
+        from hbbft_tpu.ops import curve_fused
+
+        if curve_fused._use():
+            return curve_fused.scalar_mul(1 if F is _F1 else 2, bits, P)
+
     acc = infinity_like(F, P)
 
     def step(acc, bit):

@@ -1,0 +1,244 @@
+"""Fused Pallas kernel for Jacobian scalar-multiplication ladders.
+
+curve.scalar_mul lowers to a lax.scan whose every iteration dispatches
+~8 stacked multiplies (3 for the doubling, 5 for the add) — ~2,200
+Pallas calls for a 254-bit G2 ladder, ~1,100 for the 128-bit
+random-linear-combination coefficients the backend's grouped
+verification uses.  At the measured ~100 µs fixed cost per call
+(PERF.md) the ladder is >95% launch overhead at protocol batch sizes.
+
+This kernel runs the WHOLE double-and-add-always ladder in one launch:
+an in-kernel ``fori_loop`` over the bit rows, per-lane bit masks read
+from a VMEM (nbits, TILE) block, Jacobian state held limbs-first in
+VMEM throughout.  One implementation serves both groups — the
+coordinate field is a tuple of 1 (Fq, G1) or 2 (Fq2, G2) limb planes,
+mirroring curve.py's field-namespace parameterization.
+
+Infinity handling matches curve.py exactly: an explicit mask lane
+(carried as a broadcast row) with total formulas and selects — the
+accumulator starts at infinity and the add's select chain handles the
+first set bit.
+
+Golden-tested against curve.scalar_mul in interpret mode
+(tests/test_curve_fused.py).  Reference analogue: scalar multiplication
+inside `threshold_crypto`'s `pairing` crate (SURVEY.md §2.2).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from hbbft_tpu.ops import fq
+from hbbft_tpu.ops import pairing_fused as _pf
+from hbbft_tpu.ops.fq_pallas import _FOLD_T
+from hbbft_tpu.ops.pairing_fused import _algebra, _scratch
+
+
+def _use() -> bool:
+    if os.environ.get("HBBFT_TPU_NO_FUSED"):
+        return False
+    return fq._use_pallas()
+
+
+# ---------------------------------------------------------------------------
+# Generic degree-k coordinate algebra: a coordinate is a k-tuple of
+# (NLIMBS, T) limb planes; k = 1 for Fq (G1), k = 2 for Fq2 (G2).
+# ---------------------------------------------------------------------------
+
+
+def _field(k: int, m, m2):
+    if k == 1:
+        mul = lambda a, b: (m(a[0], b[0]),)  # noqa: E731
+    else:
+        mul = m2  # Karatsuba fq2 (tuple in, tuple out)
+
+    add = lambda a, b: tuple(x + y for x, y in zip(a, b))  # noqa: E731
+    sub = lambda a, b: tuple(x - y for x, y in zip(a, b))  # noqa: E731
+
+    def sel(mask, a, b):  # mask: (1, T) 0/1 floats
+        return tuple(jnp.where(mask > 0, x, y) for x, y in zip(a, b))
+
+    return mul, add, sub, sel
+
+
+def _jac_double(F, P):
+    """curve.jac_double formulas on tuple coordinates."""
+    mul, add, sub, _ = F
+    X, Y, Z, inf = P
+    A = mul(X, X)
+    B = mul(Y, Y)
+    YZ = mul(Y, Z)
+    E = add(add(A, A), A)
+    C = mul(B, B)
+    t = mul(add(X, B), add(X, B))
+    Fv = mul(E, E)
+    D2 = sub(sub(t, A), C)
+    D = add(D2, D2)
+    X3 = sub(Fv, add(D, D))
+    C4 = add(add(C, C), add(C, C))
+    C8 = add(C4, C4)
+    EDX3 = mul(E, sub(D, X3))
+    Y3 = sub(EDX3, C8)
+    Z3 = add(YZ, YZ)
+    return (X3, Y3, Z3, inf)
+
+
+def _jac_add(F, P, Q):
+    """curve.jac_add (unequal add; infinity via mask selects)."""
+    mul, add, sub, sel = F
+    X1, Y1, Z1, inf1 = P
+    X2, Y2, Z2, inf2 = Q
+    Z1Z1 = mul(Z1, Z1)
+    Z2Z2 = mul(Z2, Z2)
+    Y1Z2 = mul(Y1, Z2)
+    Y2Z1 = mul(Y2, Z1)
+    Z1Z2 = mul(Z1, Z2)
+    U1 = mul(X1, Z2Z2)
+    U2 = mul(X2, Z1Z1)
+    S1 = mul(Y1Z2, Z2Z2)
+    S2 = mul(Y2Z1, Z1Z1)
+    H = sub(U2, U1)
+    Rr = sub(S2, S1)
+    H2 = mul(H, H)
+    Z3 = mul(Z1Z2, H)
+    H3 = mul(H, H2)
+    U1H2 = mul(U1, H2)
+    R2 = mul(Rr, Rr)
+    X3 = sub(sub(R2, H3), add(U1H2, U1H2))
+    RY = mul(Rr, sub(U1H2, X3))
+    S1H3 = mul(S1, H3)
+    Y3 = sub(RY, S1H3)
+
+    X3 = sel(inf1, X2, sel(inf2, X1, X3))
+    Y3 = sel(inf1, Y2, sel(inf2, Y1, Y3))
+    Z3 = sel(inf1, Z2, sel(inf2, Z1, Z3))
+    return (X3, Y3, Z3, inf1 * inf2)
+
+
+def _ladder_kernel(k: int, p_ref, bits_ref, fold_ref, out_ref, acc_ref=None):
+    m, m2, _sq2 = _algebra(fold_ref[:], acc_ref)
+    F = _field(k, m, m2)
+    t = p_ref.shape[-1]
+
+    def coord(ref, base):
+        return tuple(ref[base + j] for j in range(k))
+
+    P = (
+        coord(p_ref, 0),
+        coord(p_ref, k),
+        coord(p_ref, 2 * k),
+        p_ref[3 * k][0:1, :],  # inf mask row (1, T)
+    )
+
+    zero = jnp.zeros((fq.NLIMBS, t), dtype=fq.DTYPE)
+    # ONE = [1, 0, 0, ...] built in-kernel (captured array constants are
+    # rejected by pallas_call; an iota row mask is free).
+    row = jax.lax.broadcasted_iota(jnp.int32, (fq.NLIMBS, t), 0)
+    onev = jnp.where(row == 0, 1.0, 0.0).astype(fq.DTYPE)
+    acc0 = (
+        (zero,) * k,
+        (onev,) + (zero,) * (k - 1),
+        (zero,) * k,
+        jnp.ones((1, t), dtype=fq.DTYPE),  # starts at infinity
+    )
+
+    nbits = bits_ref.shape[0]
+
+    def body(i, acc):
+        acc = _jac_double(F, acc)
+        cand = _jac_add(F, acc, P)
+        b = bits_ref[pl.ds(i, 1), :]  # (1, T) per-lane bit mask
+        sel = F[3]
+        return (
+            sel(b, cand[0], acc[0]),
+            sel(b, cand[1], acc[1]),
+            sel(b, cand[2], acc[2]),
+            jnp.where(b > 0, cand[3], acc[3]),
+        )
+
+    acc = jax.lax.fori_loop(0, nbits, body, acc0)
+
+    for j in range(k):
+        out_ref[j] = acc[0][j]
+        out_ref[k + j] = acc[1][j]
+        out_ref[2 * k + j] = acc[2][j]
+    out_ref[3 * k] = jnp.broadcast_to(acc[3], (fq.NLIMBS, t))
+
+
+@functools.lru_cache(maxsize=None)
+def _ladder_call(k: int, nbits: int, n_tiles: int, interpret: bool, tile: int):
+    rows = 3 * k + 1
+    return pl.pallas_call(
+        functools.partial(_ladder_kernel, k),
+        out_shape=jax.ShapeDtypeStruct(
+            (rows, fq.NLIMBS, n_tiles * tile), fq.DTYPE
+        ),
+        grid=(n_tiles,),
+        in_specs=[
+            pl.BlockSpec((rows, fq.NLIMBS, tile), lambda i: (0, 0, i)),
+            pl.BlockSpec((nbits, tile), lambda i: (0, i)),
+            pl.BlockSpec(
+                (fq.NLIMBS, fq.CONV - fq.FOLD_FROM), lambda i: (0, 0)
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (rows, fq.NLIMBS, tile), lambda i: (0, 0, i)
+        ),
+        scratch_shapes=_scratch(),
+        interpret=interpret,
+    )
+
+
+# ---------------------------------------------------------------------------
+# XLA-side wrapper: curve.py point pytrees in/out.
+# ---------------------------------------------------------------------------
+
+
+def _leaves(coordinate, k):
+    return list(coordinate) if k == 2 else [coordinate]
+
+
+def scalar_mul(k: int, bits: jnp.ndarray, P, interpret: bool | None = None):
+    """Fused drop-in for curve.scalar_mul.
+
+    ``k`` is the coordinate-field degree (1 = G1, 2 = G2); ``P`` the
+    curve.py Jacobian point pytree; ``bits`` (B, nbits) MSB-first.
+    """
+    X, Y, Z, inf = P
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    lanes = jnp.shape(bits)[0]
+    n_tiles = max(1, -(-lanes // _pf.TILE))
+    pad = n_tiles * _pf.TILE - lanes
+
+    leaves = _leaves(X, k) + _leaves(Y, k) + _leaves(Z, k)
+    inf_plane = jnp.broadcast_to(
+        jnp.asarray(inf, fq.DTYPE)[:, None], (lanes, fq.NLIMBS)
+    )
+    stacked = _pf.pack_rows(leaves + [inf_plane], lanes)
+    bits_cols = jnp.asarray(bits, fq.DTYPE).T  # (nbits, lanes)
+    if pad:
+        bits_cols = jnp.pad(bits_cols, ((0, 0), (0, pad)))
+
+    nbits = int(jnp.shape(bits)[1])
+    out = _ladder_call(k, nbits, n_tiles, interpret, _pf.TILE)(
+        stacked, bits_cols, jnp.asarray(_FOLD_T)
+    )
+
+    g = lambda r: out[r, :, :lanes].T  # noqa: E731
+
+    def coord(base):
+        if k == 1:
+            return g(base)
+        return (g(base), g(base + 1))
+
+    inf_out = out[3 * k, 0, :lanes] > 0.5
+    return (coord(0), coord(k), coord(2 * k), inf_out)

@@ -319,7 +319,11 @@ def pow_fixed(x: jnp.ndarray, exponent: int) -> jnp.ndarray:
     kernel calls per exponent bit, which at ~100 µs fixed cost per call
     dominates everything for the 381-bit Fermat inverse.
     """
-    if exponent >= 1 and _use_pallas():
+    if (
+        exponent >= 1
+        and _use_pallas()
+        and not os.environ.get("HBBFT_TPU_NO_FUSED")
+    ):
         from hbbft_tpu.ops import fq_pallas
 
         return fq_pallas.pow_fixed(x, exponent)

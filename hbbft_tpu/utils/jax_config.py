@@ -29,6 +29,32 @@ def _host_tag() -> str:
     return f"{platform.machine()}-{digest[:12]}"
 
 
+def raise_stack_limit(soft_bytes: int = 512 << 20) -> None:
+    """Raise RLIMIT_STACK's soft limit for XLA:CPU compilation.
+
+    XLA:CPU runs deeply recursive LLVM passes on the CALLING thread; on
+    the ~8 MB default main-thread stack the RLC verification graphs
+    (vmapped ladders + two Miller loops + final exp in one jit) segfault
+    nondeterministically inside backend_compile_and_load — observed five
+    times on 2026-07-30, always in an RLC-graph compile, including a
+    fully solo pytest run.  The Linux main-thread stack grows on demand
+    up to the soft limit, so raising it in-process (before the compile)
+    is sufficient; spawned threads are unaffected (their stacks are
+    fixed at creation), matching the observed main-thread crash site.
+    """
+    try:
+        import resource
+
+        soft, hard = resource.getrlimit(resource.RLIMIT_STACK)
+        want = soft_bytes if hard == resource.RLIM_INFINITY else min(
+            soft_bytes, hard
+        )
+        if soft != resource.RLIM_INFINITY and soft < want:
+            resource.setrlimit(resource.RLIMIT_STACK, (want, hard))
+    except Exception:
+        pass  # best effort — platform without resource or denied
+
+
 def enable_compile_cache(cache_dir: str | None = None) -> None:
     """Point JAX's persistent compilation cache at a repo-local,
     host-fingerprinted dir.

@@ -32,10 +32,38 @@ sys.path.insert(0, _REPO)
 # the host that wrote them (warn-then-SIGILL / hard abort; two pytest runs
 # died that way 2026-07-30, see utils/jax_config.py).  The suite therefore
 # recompiles per run; keep per-test graph sizes small.
-from hbbft_tpu.utils.jax_config import enable_compile_cache  # noqa: E402
+from hbbft_tpu.utils.jax_config import (  # noqa: E402
+    enable_compile_cache,
+    raise_stack_limit,
+)
 
 enable_compile_cache()
+# XLA:CPU compiles the big RLC/pairing graphs with deeply recursive LLVM
+# passes on the main thread; the default 8 MB stack segfaults
+# nondeterministically (see utils/jax_config.raise_stack_limit).
+raise_stack_limit()
 
 
 def pytest_configure(config):
     config.addinivalue_line("markers", "slow: slow tests (Python pairings)")
+
+
+def pytest_collection_modifyitems(config, items):
+    """Run the heavy-XLA-compile tests FIRST.
+
+    XLA:CPU segfaults compiling the big RLC verification graphs late in
+    a long pytest process (observed 6/6 full-suite runs on 2026-07-30,
+    always at an RLC compile ~45 min in), while the same tests pass
+    consistently as young solo processes (3/3).  Whatever accumulated
+    process state triggers the compiler bug, compiling the big graphs
+    early — before hundreds of other compilations — avoids it.
+    """
+    heavy = (
+        "test_rlc_verify",
+        "test_tpu_backend",
+        "test_mesh_backend",
+        "test_honey_badger_tpu",
+    )
+    items.sort(
+        key=lambda it: 0 if any(h in it.nodeid for h in heavy) else 1
+    )

@@ -1,0 +1,105 @@
+"""Golden tests: fused scalar-mul ladder kernel vs the scan ladder.
+
+Interpret mode on CPU; short bit widths keep in-kernel iteration counts
+(and thus interpret cost) small — any scalar < 2^width is ladder-safe
+(curve.scalars_to_bits).
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from hbbft_tpu.crypto import bls381 as gold
+from hbbft_tpu.ops import curve, curve_fused, pairing_fused
+
+
+@pytest.fixture(scope="module", autouse=True)
+def small_tile():
+    old = pairing_fused.TILE
+    pairing_fused.TILE = 128
+    curve_fused._ladder_call.cache_clear()
+    yield
+    pairing_fused.TILE = old
+    curve_fused._ladder_call.cache_clear()
+
+
+@pytest.fixture(scope="module")
+def rng():
+    return random.Random(13)
+
+
+def _bits(rng, n, width):
+    scalars = [rng.randrange(0, 1 << width) for _ in range(n)]
+    scalars[-1] = 0  # exercise an all-zero ladder (stays at infinity)
+    return scalars, jnp.asarray(curve.scalars_to_bits(scalars, width))
+
+
+def test_g1_ladder_matches_golden(rng):
+    width, n = 16, 4
+    scalars, bits = _bits(rng, n, width)
+    pts = [gold.G1_GEN] * (n - 1) + [None]  # include an infinite input
+    P = curve.g1_to_device(pts)
+    out = curve_fused.scalar_mul(1, bits, P, interpret=True)
+    got = curve.g1_from_device(out)
+    for i in range(n):
+        if pts[i] is None or scalars[i] == 0:
+            assert got[i] is None
+        else:
+            assert got[i] == gold.ec_mul(gold.FQ, scalars[i], pts[i])
+
+
+def test_g2_ladder_matches_golden(rng):
+    width, n = 16, 3
+    scalars, bits = _bits(rng, n, width)
+    pts = [gold.G2_GEN] * n
+    P = curve.g2_to_device(pts)
+    out = curve_fused.scalar_mul(2, bits, P, interpret=True)
+    got = curve.g2_from_device(out)
+    for i in range(n):
+        if scalars[i] == 0:
+            assert got[i] is None
+        else:
+            assert got[i] == gold.ec_mul(gold.FQ2, scalars[i], pts[i])
+
+
+def test_g2_ladder_matches_scan_path(rng):
+    """Fused kernel vs the lax.scan ladder on identical inputs."""
+    width, n = 24, 3
+    _, bits = _bits(rng, n, width)
+    P = curve.g2_to_device([gold.G2_GEN] * n)
+    want = curve.scalar_mul(curve._F2, bits, P)
+    got = curve_fused.scalar_mul(2, bits, P, interpret=True)
+    assert curve.g2_from_device(got) == curve.g2_from_device(want)
+
+
+def test_fused_ladder_under_vmap(rng, monkeypatch):
+    """The RLC verification graphs vmap linear_combine over groups; the
+    fused ladder must produce identical combines under vmap batching."""
+    width, G, K = 16, 2, 3
+    scal = [[rng.randrange(1, 1 << width) for _ in range(K)] for _ in range(G)]
+    bits = jnp.asarray(
+        np.stack([curve.scalars_to_bits(r, width) for r in scal])
+    )
+    flat = curve.g2_to_device([gold.G2_GEN] * (G * K))
+    P = jax.tree_util.tree_map(
+        lambda c: jnp.asarray(c).reshape((G, K) + jnp.asarray(c).shape[1:]),
+        flat,
+    )
+    zeros = jnp.zeros((G, K), dtype=bool)
+
+    want = jax.vmap(curve.linear_combine_g2)(P, bits, zeros)
+
+    monkeypatch.setattr(curve_fused, "_use", lambda: True)
+    got = jax.vmap(curve.linear_combine_g2)(P, bits, zeros)
+
+    for gi in range(G):
+        take = lambda t: jax.tree_util.tree_map(  # noqa: E731
+            lambda c: np.asarray(c)[gi], t
+        )
+        assert curve.g2_from_device(take(got)) == curve.g2_from_device(
+            take(want)
+        )
