@@ -94,6 +94,7 @@ class Simulation:
         self._seq = 0
         self.delivered = 0
         self._pending_work: List[Tuple[int, CryptoWork]] = []
+        self._resumed = False
 
     # -- plumbing ------------------------------------------------------------
 
@@ -156,20 +157,104 @@ class Simulation:
                     if follow:
                         self._emit(self.nodes[owner], follow)
 
+    # -- checkpoint/resume ---------------------------------------------------
+
+    def checkpoint(self) -> bytes:
+        """Serialize the whole simulation — every node's protocol stack,
+        clocks, outputs, the in-flight event heap, and the shared RNG — to
+        canonical snapshot bytes (utils/snapshot.py; the SURVEY.md §5
+        checkpoint capability at simulation scope)."""
+        from hbbft_tpu.utils.snapshot import save_node
+
+        if self._pending_work:
+            raise RuntimeError(
+                "checkpoint only at a flushed barrier (pending CryptoWork)"
+            )
+        return save_node(
+            {
+                "algos": {nid: n.algo for nid, n in self.nodes.items()},
+                "clocks": {nid: n.clock for nid, n in self.nodes.items()},
+                "outputs": {nid: n.outputs for nid, n in self.nodes.items()},
+                "sent": {nid: n.sent_msgs for nid, n in self.nodes.items()},
+                "events": self.events,
+                "seq": self._seq,
+                "delivered": self.delivered,
+                "rng": self.rng,
+            }
+        )
+
+    @classmethod
+    def from_checkpoint(cls, args, backend, blob: bytes) -> "Simulation":
+        """Resume without rebuilding nodes: skips the N-node key generation
+        ``__init__`` performs (seconds of BLS keygen on the cpu backend)
+        and fills the whole simulation from the snapshot."""
+        sim = cls.__new__(cls)
+        sim.args = args
+        sim.backend = backend
+        sim.rng = random.Random()  # replaced by the snapshot's rng below
+        sim.nodes = {}
+        sim._all_ids = []
+        sim._size_cache = {}
+        sim.events = []
+        sim._seq = 0
+        sim.delivered = 0
+        sim._pending_work = []
+        sim._resumed = False
+        sim.restore(blob)
+        return sim
+
+    def restore(self, blob: bytes) -> None:
+        """Replace this simulation's state with a :meth:`checkpoint`'s.
+
+        The backend stays this instance's (environment, not state); key
+        material rides inside the serialized NetworkInfos."""
+        from hbbft_tpu.utils.snapshot import SnapshotError, load_node
+
+        state = load_node(blob, self.backend)
+        snap_ids = sorted(state["algos"])
+        if len(snap_ids) != self.args.num_nodes:
+            raise SnapshotError(
+                f"snapshot has {len(snap_ids)} nodes, -n/--num-nodes is "
+                f"{self.args.num_nodes}"
+            )
+        if self.nodes and sorted(self.nodes) != snap_ids:
+            raise SnapshotError(
+                f"snapshot has nodes {snap_ids}, this simulation has "
+                f"{sorted(self.nodes)}"
+            )
+        if not self.nodes:  # from_checkpoint shell
+            self.nodes = {nid: SimNode(nid, None) for nid in snap_ids}
+            self._all_ids = snap_ids
+        self._resumed = True
+        for nid, node in self.nodes.items():
+            node.algo = state["algos"][nid]
+            node.clock = state["clocks"][nid]
+            node.outputs = state["outputs"][nid]
+            node.sent_msgs = state["sent"][nid]
+        self.events = state["events"]
+        self._seq = state["seq"]
+        self.delivered = state["delivered"]
+        self.rng = state["rng"]
+        self._pending_work = []
+        self._size_cache.clear()
+
     # -- run -----------------------------------------------------------------
 
     def run(self) -> List[dict]:
         a = self.args
-        # Seed every node's queue with its share of transactions.
-        for nid, node in sorted(self.nodes.items()):
-            for k in range(a.txns):
-                tx = f"tx-{nid}-{k}-".encode() + bytes(a.tx_size)
-                self._emit(node, node.algo.handle_input(("user", tx), rng=self.rng))
-        self._flush_work()
+        # Seed every node's queue with its share of transactions — unless
+        # this simulation was restored from a checkpoint (whose queue state
+        # rode in with the snapshot, even if no epoch completed before it).
+        if not self._resumed:
+            for nid, node in sorted(self.nodes.items()):
+                for k in range(a.txns):
+                    tx = f"tx-{nid}-{k}-".encode() + bytes(a.tx_size)
+                    self._emit(node, node.algo.handle_input(("user", tx), rng=self.rng))
+            self._flush_work()
 
         target = a.epochs
         rows = []
-        done_epochs = 0
+        done_epochs = min(len(n.outputs) for n in self.nodes.values())
         wall0 = time.perf_counter()
         while done_epochs < target:
             if not self.events:
@@ -294,6 +379,18 @@ def main(argv: Optional[List[str]] = None) -> int:
         "whole-network engine (hbbft_tpu/engine)",
     )
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument(
+        "--checkpoint",
+        metavar="FILE",
+        help="write a canonical whole-simulation snapshot here after the run "
+        "(object engine only)",
+    )
+    p.add_argument(
+        "--resume",
+        metavar="FILE",
+        help="resume from a --checkpoint snapshot; --epochs is the TOTAL "
+        "epoch count including pre-checkpoint epochs",
+    )
     args = p.parse_args(argv)
 
     if args.num_nodes <= 3 * args.num_faulty:
@@ -306,10 +403,20 @@ def main(argv: Optional[List[str]] = None) -> int:
         f"batch={args.batch_size} backend={args.backend} engine={args.engine}"
     )
     if args.engine == "array":
+        if args.checkpoint or args.resume:
+            p.error("--checkpoint/--resume require the object engine")
         rows = run_array(args, backend, rng)
     else:
-        sim = Simulation(args, backend, rng)
+        if args.resume:
+            with open(args.resume, "rb") as fh:
+                sim = Simulation.from_checkpoint(args, backend, fh.read())
+        else:
+            sim = Simulation(args, backend, rng)
         rows = sim.run()
+        if args.checkpoint:
+            with open(args.checkpoint, "wb") as fh:
+                fh.write(sim.checkpoint())
+            print(f"checkpoint written to {args.checkpoint}")
     print(
         f"{'epoch':>6} {'virt ms':>10} {'wall s':>8} {'txns':>6} {'msgs':>8} "
         f"{'shr.vrf':>8} {'pairchk':>8} {'shr.cmb':>8} {'disp':>6}"

@@ -19,8 +19,12 @@ from hbbft_tpu.ops import curve, curve_fused, pairing_fused
 
 @pytest.fixture(scope="module", autouse=True)
 def small_tile():
+    # Interpret-mode cost scales with TILE (lanes are emulated in Python):
+    # the real sublane width is 8, and 3-4 test lanes padded to TILE=128
+    # made this module take ~18 min of CPU suite time.  TILE=8 keeps the
+    # same kernel code paths at ~1/16 the emulation work.
     old = pairing_fused.TILE
-    pairing_fused.TILE = 128
+    pairing_fused.TILE = 8
     curve_fused._ladder_call.cache_clear()
     yield
     pairing_fused.TILE = old
@@ -39,7 +43,7 @@ def _bits(rng, n, width):
 
 
 def test_g1_ladder_matches_golden(rng):
-    width, n = 16, 4
+    width, n = 8, 4
     scalars, bits = _bits(rng, n, width)
     pts = [gold.G1_GEN] * (n - 1) + [None]  # include an infinite input
     P = curve.g1_to_device(pts)
@@ -53,7 +57,7 @@ def test_g1_ladder_matches_golden(rng):
 
 
 def test_g2_ladder_matches_golden(rng):
-    width, n = 16, 3
+    width, n = 8, 3
     scalars, bits = _bits(rng, n, width)
     pts = [gold.G2_GEN] * n
     P = curve.g2_to_device(pts)
@@ -68,7 +72,7 @@ def test_g2_ladder_matches_golden(rng):
 
 def test_g2_ladder_matches_scan_path(rng):
     """Fused kernel vs the lax.scan ladder on identical inputs."""
-    width, n = 24, 3
+    width, n = 12, 3
     _, bits = _bits(rng, n, width)
     P = curve.g2_to_device([gold.G2_GEN] * n)
     want = curve.scalar_mul(curve._F2, bits, P)
@@ -79,7 +83,7 @@ def test_g2_ladder_matches_scan_path(rng):
 def test_fused_ladder_under_vmap(rng, monkeypatch):
     """The RLC verification graphs vmap linear_combine over groups; the
     fused ladder must produce identical combines under vmap batching."""
-    width, G, K = 16, 2, 3
+    width, G, K = 8, 2, 3
     scal = [[rng.randrange(1, 1 << width) for _ in range(K)] for _ in range(G)]
     bits = jnp.asarray(
         np.stack([curve.scalars_to_bits(r, width) for r in scal])
