@@ -362,26 +362,15 @@ def bench_epochs_n100() -> dict:
     }
 
 
-def bench_array_engine_n100() -> dict:
-    """North-star macro config through the ARRAY ENGINE: N=100 f=33
-    HoneyBadger epochs/sec, whole-network lockstep execution with the full
-    per-receiver workload (6.94M messages, ~10.7M hashes, ~10⁶ share
-    verifies per epoch — identical counts to the object runtime, see
-    hbbft_tpu/engine/array_engine.py).
-
-    BENCH_ARRAY_BACKEND=tpu routes crypto through the device backend;
-    BENCH_ARRAY_DEDUP=1 reports the memoizing-simulation variant.
-    """
+def _bench_array_engine(
+    metric: str, n: int, epochs: int, baseline_eps: float, dedup: bool, dynamic: bool
+) -> dict:
+    """Shared array-engine macro bench: warm one epoch (compile/caches),
+    then time ``epochs`` full-workload lockstep epochs at network size n."""
     from examples.simulation import make_backend
     from hbbft_tpu.engine import ArrayHoneyBadgerNet
 
-    n = _env_int("BENCH_ARRAY_N", 100)
-    epochs = _env_int("BENCH_ARRAY_EPOCHS", 2)
     backend = make_backend(os.environ.get("BENCH_ARRAY_BACKEND", "mock"))
-    dedup = os.environ.get("BENCH_ARRAY_DEDUP", "0") == "1"
-    # BASELINE config 3 names DynamicHoneyBadger: run the DHB flavor
-    # (internal contribution envelope + the no-churn vote machinery).
-    dynamic = os.environ.get("BENCH_ARRAY_DYNAMIC", "1") == "1"
     net = ArrayHoneyBadgerNet(
         range(n), backend=backend, seed=0, dedup_verifies=dedup,
         dynamic=dynamic,
@@ -391,21 +380,58 @@ def bench_array_engine_n100() -> dict:
     net.run_epochs(epochs, payload_size=64)
     dt = time.perf_counter() - t0
     eps = epochs / dt if dt > 0 else 0.0
-    rep = net.reports[-1]
-    # Same estimated baseline as bench_epochs_n100: single-core Rust
-    # ~0.1 epochs/s at this config (BASELINE.md cost model).
+    rep = net.reports[-1]  # warm epoch guarantees one report even if epochs=0
     return {
-        "metric": "array_epochs_per_sec_n100",
-        "value": round(eps, 4),
+        "metric": metric,
+        "value": round(eps, 5),
         "unit": "epochs/s",
-        "vs_baseline": round(eps / 0.1, 3),
+        "vs_baseline": round(eps / baseline_eps, 3),
         "baseline": "estimated",
         "backend": backend.name,
         "dedup": dedup,
         "dynamic": dynamic,
+        "epochs": epochs,
         "messages_per_epoch": rep.messages_delivered,
         "dec_share_verifies_per_epoch": rep.dec_shares_verified,
     }
+
+
+def bench_array_engine_n100() -> dict:
+    """North-star macro config through the ARRAY ENGINE: N=100 f=33
+    epochs/sec, whole-network lockstep execution with the full per-receiver
+    workload (6.94M messages, ~10.7M hashes, ~10⁶ share verifies per epoch
+    — identical counts to the object runtime, see
+    hbbft_tpu/engine/array_engine.py).
+
+    BENCH_ARRAY_BACKEND=tpu routes crypto through the device backend;
+    BENCH_ARRAY_DEDUP=1 reports the memoizing-simulation variant.
+    BASELINE config 3 names DynamicHoneyBadger, so the DHB flavor is the
+    default.  Estimated single-core reference ≈ 0.1 epochs/s (BASELINE.md
+    cost model)."""
+    return _bench_array_engine(
+        "array_epochs_per_sec_n100",
+        n=_env_int("BENCH_ARRAY_N", 100),
+        epochs=_env_int("BENCH_ARRAY_EPOCHS", 2),
+        baseline_eps=0.1,
+        dedup=os.environ.get("BENCH_ARRAY_DEDUP", "0") == "1",
+        dynamic=os.environ.get("BENCH_ARRAY_DYNAMIC", "1") == "1",
+    )
+
+
+def bench_array_engine_n256_soak() -> dict:
+    """BASELINE config 5 (QHB N=256 f=85 sustained) through the array
+    engine: full-workload lockstep epochs — 117M delivered messages, 16.7M
+    dec-share verifies, 185M hashes each — as a sustained-throughput soak
+    point.  BENCH_SOAK_EPOCHS raises the horizon.  Baseline: the N=100
+    cost model scaled by (256/100)³ ≈ 16.8× → ≈ 0.006 epochs/s."""
+    return _bench_array_engine(
+        "array_epochs_per_sec_n256_soak",
+        n=256,
+        epochs=_env_int("BENCH_SOAK_EPOCHS", 1),
+        baseline_eps=0.006,
+        dedup=False,
+        dynamic=True,
+    )
 
 
 def _ensure_live_accelerator() -> None:
@@ -469,6 +495,8 @@ def main() -> None:
     ]
     if os.environ.get("BENCH_ARRAY", "1") != "0":
         extra.append(("array_n100", bench_array_engine_n100))
+    if os.environ.get("BENCH_SOAK", "1") != "0":
+        extra.append(("array_n256_soak", bench_array_engine_n256_soak))
     if os.environ.get("BENCH_N100", "1") != "0":
         extra.append(("n100", bench_epochs_n100))
 

@@ -63,6 +63,10 @@ def pytest_collection_modifyitems(config, items):
         "test_tpu_backend",
         "test_mesh_backend",
         "test_honey_badger_tpu",
+        # big eager tower/pairing graphs; observed segfaulting ~66 min into
+        # a full run (2026-07-30) while passing consistently when young
+        "test_pairing_fused",
+        "test_curve_fused",
     )
     items.sort(
         key=lambda it: 0 if any(h in it.nodeid for h in heavy) else 1
