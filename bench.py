@@ -481,6 +481,76 @@ def _ensure_live_accelerator() -> None:
     os.execve(sys.executable, [sys.executable] + sys.argv, env)
 
 
+def _clear_kernel_caches() -> None:
+    """Drop every functools cache holding a jitted/pallas callable so a
+    changed HBBFT_TPU_* env var takes effect on the next call."""
+    import importlib
+
+    for modname in (
+        "hbbft_tpu.ops.backend",
+        "hbbft_tpu.ops.curve_fused",
+        "hbbft_tpu.ops.pairing_fused",
+        "hbbft_tpu.ops.fq_pallas",
+        "hbbft_tpu.ops.pairing",
+        "hbbft_tpu.ops.curve",
+    ):
+        try:
+            mod = importlib.import_module(modname)
+        except Exception:
+            continue
+        for v in vars(mod).values():
+            clear = getattr(v, "cache_clear", None)
+            if callable(clear):
+                clear()
+
+
+def _with_fallback(fn):
+    """Run a bench metric; on failure retry on progressively more
+    conservative kernel paths.
+
+    The fused Pallas kernels are golden-tested in interpret mode but a
+    first Mosaic compile on new hardware can still fail; without this, one
+    rejected kernel turns the flagship metric into an error row.  Fallback
+    ladder: fused → unfused stacked kernels (HBBFT_TPU_NO_FUSED) → pure
+    XLA (HBBFT_TPU_NO_PALLAS).  The env is restored afterwards so every
+    metric independently attempts (and is labeled with) its own path;
+    rungs whose variable was already set on entry are skipped as no-ops."""
+    saved = {
+        var: os.environ.get(var)
+        for var in ("HBBFT_TPU_NO_FUSED", "HBBFT_TPU_NO_PALLAS")
+    }
+    changed = False
+    try:
+        try:
+            return fn()
+        except Exception as first:
+            errors = [first]
+            for var in saved:
+                if saved[var]:
+                    continue  # this rung is the config that just failed
+                os.environ[var] = "1"
+                changed = True
+                _clear_kernel_caches()
+                try:
+                    row = fn()
+                    row["fallback"] = var
+                    row["fallback_reason"] = repr(first)[:160]
+                    return row
+                except Exception as e:
+                    errors.append(e)
+            if len(errors) > 1:
+                raise ExceptionGroup("all kernel paths failed", errors)
+            raise first
+    finally:
+        if changed:
+            for var, val in saved.items():
+                if val is None:
+                    os.environ.pop(var, None)
+                else:
+                    os.environ[var] = val
+            _clear_kernel_caches()
+
+
 def main() -> None:
     _ensure_live_accelerator()
     if os.environ.get("BENCH_ONLY"):
@@ -512,7 +582,7 @@ def main() -> None:
         if only is not None and name not in only:
             continue
         try:
-            row = fn()
+            row = _with_fallback(fn)
             row["platform"] = platform
             print(json.dumps(row), flush=True)
         except Exception as e:  # one dead bench must not kill the others
