@@ -280,6 +280,132 @@ def _step_call(n_tiles: int, interpret: bool):
 
 
 # ---------------------------------------------------------------------------
+# Kernel 1b (opt-in, HBBFT_TPU_FUSE2=1): the ENTIRE Miller loop in one
+# launch.  The 63-bit schedule of |x| is STATIC, so the kernel unrolls it
+# as zero-run fori_loops over the double-step with explicit mixed-addition
+# steps at the ~6 set bits — one dispatch replaces 63 step dispatches plus
+# the XLA add regions.  Untested on real Mosaic until the chip is back:
+# kept off the default path (PERF.md round-2 fourth pass).
+# ---------------------------------------------------------------------------
+
+
+def _fuse2() -> bool:
+    return bool(os.environ.get("HBBFT_TPU_FUSE2"))
+
+
+def _x_segments():
+    from hbbft_tpu.ops import pairing
+
+    plan = []
+    run = 0
+    for b in pairing._X_BITS:
+        run += 1
+        if b:
+            plan.append((run, True))
+            run = 0
+    if run:
+        plan.append((run, False))
+    return tuple(plan)
+
+
+def _add_step_math(m, m2, sq2, f, X, Y, Z, xQ, yQ, xP, yP):
+    """Mixed addition R ← R + Q (Q affine) fused with its line and the
+    sparse f·line multiply (pairing._line_add + curve.jac_add algebra,
+    Z2 = 1 so U1 = X, S1 = Y, H = x_Q·Z² − X, Rr = y_Q·Z³ − Y; the line's
+    D = H·Z and N = Rr are shared with the addition)."""
+    ZZ = sq2(Z)
+    ZZZ = m2(ZZ, Z)
+    U2 = m2(xQ, ZZ)
+    S2 = m2(yQ, ZZZ)
+    H = _sub2(U2, X)
+    Rr = _sub2(S2, Y)
+
+    # Line: l = ξ·D·y_P + (Rr·x_Q − y_Q·D)·w³ − Rr·x_P·w⁵,  D = H·Z.
+    D = m2(H, Z)
+    c1a1 = _sub2(m2(Rr, xQ), m2(yQ, D))
+    u = _xi2(D)
+    c0a0 = (m(u[0], yP), m(u[1], yP))
+    c1a2 = (-m(Rr[0], xP), -m(Rr[1], xP))
+    f_new = _mul_line(m2, f, (c0a0, c1a1, c1a2))
+
+    # Addition: X3 = Rr² − H³ − 2XH², Y3 = Rr(XH² − X3) − Y·H³, Z3 = Z·H.
+    H2 = sq2(H)
+    H3 = m2(H, H2)
+    XH2 = m2(X, H2)
+    R2 = sq2(Rr)
+    X3 = _sub2(_sub2(R2, H3), _add2(XH2, XH2))
+    Y3 = _sub2(m2(Rr, _sub2(XH2, X3)), m2(Y, H3))
+    Z3 = m2(Z, H)
+    return f_new, X3, Y3, Z3
+
+
+def _miller_full_kernel(segments, q_ref, pq_ref, fold_ref, out_ref, acc_ref=None):
+    fold_t = fold_ref[:]
+    m, m2, sq2 = _algebra(fold_t, acc_ref)
+    xP, yP = pq_ref[0], pq_ref[1]
+    xQ = (q_ref[0], q_ref[1])
+    yQ = (q_ref[2], q_ref[3])
+
+    t = xP.shape[-1]
+    one = jnp.zeros((fq.NLIMBS, t), dtype=fq.DTYPE).at[0].set(1.0)
+    zero = jnp.zeros((fq.NLIMBS, t), dtype=fq.DTYPE)
+    f = tuple(
+        tuple((one if (i, j, k) == (0, 0, 0) else zero) for k in (0, 1))
+        for i in (0, 1)
+        for j in (0, 1, 2)
+    )
+    # regroup to ((3×fq2), (3×fq2))
+    f = (f[0:3], f[3:6])
+    X, Y, Z = xQ, yQ, (one, zero)
+
+    # No per-iteration renormalization needed: every carry component is a
+    # product (m/m2 outputs, already carried) or a small linear combination
+    # of them, and `m` renormalizes its operands — the same dataflow the
+    # per-step scan path has across kernel boundaries.
+    def double_body(_, carry):
+        f, X, Y, Z = carry
+        f2 = _sqr12(m2, f)
+        line, X3, Y3, Z3 = _double_step_math(m, m2, sq2, X, Y, Z, xP, yP)
+        return _mul_line(m2, f2, line), X3, Y3, Z3
+
+    carry = (f, X, Y, Z)
+    for run, add_after in segments:
+        carry = jax.lax.fori_loop(0, run, double_body, carry)
+        if add_after:
+            f, X, Y, Z = carry
+            f, X3, Y3, Z3 = _add_step_math(
+                m, m2, sq2, f, X, Y, Z, xQ, yQ, xP, yP
+            )
+            carry = (f, X3, Y3, Z3)
+
+    f, _, _, _ = carry
+    _write_f12(out_ref, f)
+
+
+@functools.lru_cache(maxsize=None)
+def _miller_full_call(segments, n_tiles: int, interpret: bool):
+    return pl.pallas_call(
+        functools.partial(_miller_full_kernel, segments),
+        out_shape=jax.ShapeDtypeStruct(
+            (F12_ROWS, fq.NLIMBS, n_tiles * TILE), fq.DTYPE
+        ),
+        grid=(n_tiles,),
+        in_specs=[
+            pl.BlockSpec((4, fq.NLIMBS, TILE), lambda i: (0, 0, i)),
+            pl.BlockSpec((2, fq.NLIMBS, TILE), lambda i: (0, 0, i)),
+            pl.BlockSpec(
+                (fq.NLIMBS, fq.CONV - fq.FOLD_FROM), lambda i: (0, 0)
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (F12_ROWS, fq.NLIMBS, TILE), lambda i: (0, 0, i)
+        ),
+        scratch_shapes=_scratch(),
+        interpret=interpret,
+    )
+
+
+# ---------------------------------------------------------------------------
 # Kernel 2: k cyclotomic squarings in one launch (fori_loop inside).
 # ---------------------------------------------------------------------------
 
@@ -337,31 +463,84 @@ def _reduce_cols(x, fold_t):
     return _carry_cols(x)
 
 
+def _flat_to_f12(flat):
+    return (
+        ((flat[0], flat[1]), (flat[2], flat[3]), (flat[4], flat[5])),
+        ((flat[6], flat[7]), (flat[8], flat[9]), (flat[10], flat[11])),
+    )
+
+
+def _cyclo_sqr_body(m2, sq2, fold_t):
+    """fori_loop body: one reduced Granger–Scott squaring on flat f12
+    state (shared by the k-run kernel and the FUSE2 whole-chain kernel)."""
+
+    def body(_, flat):
+        out = _cyclo_sqr_math(m2, sq2, _flat_to_f12(flat))
+        return [
+            _reduce_cols(c, fold_t) for six in out for two in six for c in two
+        ]
+
+    return body
+
+
 def _cyclo_run_kernel(k: int, state_ref, fold_ref, out_ref, acc_ref=None):
     fold_t = fold_ref[:]
     m, m2, sq2 = _algebra(fold_t, acc_ref)
     f0 = _read_f12(state_ref)
     flat0 = [c for six in f0 for two in six for c in two]
-
-    def body(_, flat):
-        f = (
-            ((flat[0], flat[1]), (flat[2], flat[3]), (flat[4], flat[5])),
-            ((flat[6], flat[7]), (flat[8], flat[9]), (flat[10], flat[11])),
-        )
-        out = _cyclo_sqr_math(m2, sq2, f)
-        return [
-            _reduce_cols(c, fold_t) for six in out for two in six for c in two
-        ]
-
-    flat = jax.lax.fori_loop(0, k, body, flat0)
-    for i, c in enumerate(flat):
-        out_ref[i] = c
+    flat = jax.lax.fori_loop(0, k, _cyclo_sqr_body(m2, sq2, fold_t), flat0)
+    _write_f12(out_ref, _flat_to_f12(flat))
 
 
 @functools.lru_cache(maxsize=None)
 def _cyclo_run_call(k: int, n_tiles: int, interpret: bool):
     return pl.pallas_call(
         functools.partial(_cyclo_run_kernel, k),
+        out_shape=jax.ShapeDtypeStruct(
+            (F12_ROWS, fq.NLIMBS, n_tiles * TILE), fq.DTYPE
+        ),
+        grid=(n_tiles,),
+        in_specs=[
+            pl.BlockSpec((F12_ROWS, fq.NLIMBS, TILE), lambda i: (0, 0, i)),
+            pl.BlockSpec(
+                (fq.NLIMBS, fq.CONV - fq.FOLD_FROM), lambda i: (0, 0)
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (F12_ROWS, fq.NLIMBS, TILE), lambda i: (0, 0, i)
+        ),
+        scratch_shapes=_scratch(),
+        interpret=interpret,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Kernel 2b (opt-in, HBBFT_TPU_FUSE2=1): a whole x-chain m^BLS_X in one
+# launch — the segment plan is static, so squaring runs and the ~6 set-bit
+# multiplies unroll in-kernel (one dispatch per chain instead of ~16).
+# ---------------------------------------------------------------------------
+
+
+def _pow_chain_kernel(exponent: int, m_ref, fold_ref, out_ref, acc_ref=None):
+    fold_t = fold_ref[:]
+    m, m2, sq2 = _algebra(fold_t, acc_ref)
+    base = _read_f12(m_ref)
+    base_flat = [c for six in base for two in six for c in two]
+    body = _cyclo_sqr_body(m2, sq2, fold_t)
+
+    flat = list(base_flat)
+    for run, mult in _segments(exponent):
+        flat = jax.lax.fori_loop(0, run, body, flat)
+        if mult:
+            prod = _mul12(m2, _flat_to_f12(flat), base)
+            flat = [c for six in prod for two in six for c in two]
+    _write_f12(out_ref, _flat_to_f12(flat))
+
+
+@functools.lru_cache(maxsize=None)
+def _pow_chain_call(exponent: int, n_tiles: int, interpret: bool):
+    return pl.pallas_call(
+        functools.partial(_pow_chain_kernel, exponent),
         out_shape=jax.ShapeDtypeStruct(
             (F12_ROWS, fq.NLIMBS, n_tiles * TILE), fq.DTYPE
         ),
@@ -486,6 +665,26 @@ def miller_loop(P, Qa):
     Qa = (xQ, yQ, infQ)
     batch_shape = (lanes,)
 
+    fold = jnp.asarray(_FOLD_T)
+
+    if _fuse2():
+        # Whole loop in ONE launch (bit schedule unrolled in-kernel).
+        q = pack_rows([xQ[0], xQ[1], yQ[0], yQ[1]], lanes)
+        pqf = pack_rows([xP, yP], lanes)
+        out = _miller_full_call(_x_segments(), n_tiles, interpret)(
+            q, pqf, fold
+        )
+        f = unpack_f12(out, lanes)
+        if BLS_X_IS_NEG:
+            f = tower.fq12_conj(f)
+        neutral = infP | infQ
+        f = tower.fq12_select(
+            neutral, tower.fq12_broadcast_one(batch_shape), f
+        )
+        return jax.tree_util.tree_map(
+            lambda c: c.reshape(tuple(out_shape) + (fq.NLIMBS,)), f
+        )
+
     one2 = tower.fq2_broadcast(tower.FQ2_ONE, batch_shape)
     f1 = tower.fq12_broadcast_one(batch_shape)
     state = pack_rows(
@@ -493,7 +692,6 @@ def miller_loop(P, Qa):
         lanes,
     )
     pq = pack_rows([xP, yP], lanes)
-    fold = jnp.asarray(_FOLD_T)
     Qj = (xQ, yQ, one2, jnp.zeros(batch_shape, dtype=bool))
 
     step = _step_call(n_tiles, interpret)
@@ -560,9 +758,12 @@ def _segments(exponent: int):
 def cyclo_pow(packed_m, exponent: int, n_tiles: int):
     """m^exponent for cyclotomic packed m — one launch per zero-run plus
     one fq12-multiply launch per set bit (drop-in for the scan in
-    tower.fq12_cyclo_pow_segmented, minus ~10× the dispatches)."""
+    tower.fq12_cyclo_pow_segmented, minus ~10× the dispatches).  With
+    HBBFT_TPU_FUSE2=1 the whole chain runs in a single launch."""
     interpret = _interpret()
     fold = jnp.asarray(_FOLD_T)
+    if _fuse2():
+        return _pow_chain_call(exponent, n_tiles, interpret)(packed_m, fold)
     acc = packed_m
     for run, mult in _segments(exponent):
         acc = _cyclo_run_call(run, n_tiles, interpret)(acc, fold)

@@ -1,0 +1,147 @@
+"""Golden tests for the opt-in HBBFT_TPU_FUSE2 whole-loop kernels
+(single-launch Miller loop / x-chain pow, pairing_fused._miller_full_call
+and _pow_chain_call).
+
+The default tests drive the kernels with SMALL segment plans / exponents
+against references composed from the already-golden-tested building
+blocks (`_step_call` + `_miller_add_step`, `_cyclo_run_call` +
+`_mul12_call`) — the kernel bodies are identical for any plan, so a small
+plan validates the double-step, the mixed-addition step, and the segment
+plumbing in minutes instead of hours (the full 63-bit schedule in CPU
+interpret mode exceeded a 50-minute budget).
+
+The full-width end-to-end golden (whole verification equation through the
+FUSE2 path) is gated behind HBBFT_TPU_FUSE2_FULL_GOLDENS=1 — run it
+one-off before flipping FUSE2 on by default."""
+
+import os
+import random
+
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from hbbft_tpu.crypto import bls381 as gold
+from hbbft_tpu.crypto.field import R as SUBR
+from hbbft_tpu.ops import pairing, pairing_fused, tower
+
+
+@pytest.fixture(scope="module", autouse=True)
+def small_tile():
+    calls = (
+        pairing_fused._step_call,
+        pairing_fused._cyclo_run_call,
+        pairing_fused._mul12_call,
+        pairing_fused._miller_full_call,
+        pairing_fused._pow_chain_call,
+    )
+    old = pairing_fused.TILE
+    pairing_fused.TILE = 8
+    for c in calls:
+        c.cache_clear()
+    yield
+    pairing_fused.TILE = old
+    for c in calls:
+        c.cache_clear()
+
+
+@pytest.fixture(scope="module")
+def rng():
+    return random.Random(31)
+
+
+@pytest.fixture(scope="module")
+def points(rng):
+    quads = []
+    for a in (rng.randrange(1, SUBR), 1):
+        quads.append(
+            (
+                gold.ec_mul(gold.FQ, a, gold.G1_GEN),
+                gold.ec_mul(gold.FQ2, (a * 5 + 2) % SUBR, gold.G2_GEN),
+            )
+        )
+    P = pairing.g1_affine_to_device([q[0] for q in quads])
+    Qa = pairing.g2_affine_to_device([q[1] for q in quads])
+    return P, Qa
+
+
+def _ref_miller_custom(segments, P, Qa):
+    """Reference Miller value for an arbitrary segment plan, composed from
+    the per-step scan primitives (golden-tested in test_pairing_fused.py /
+    test_pairing_jax.py)."""
+    xP, yP, _ = P
+    xQ, yQ, _ = Qa
+    batch_shape = jnp.asarray(xP).shape[:-1]
+    one2 = tower.fq2_broadcast(tower.FQ2_ONE, batch_shape)
+    Rj = (xQ, yQ, one2, jnp.zeros(batch_shape, dtype=bool))
+    Qj = (xQ, yQ, one2, jnp.zeros(batch_shape, dtype=bool))
+    f = tower.fq12_broadcast_one(batch_shape)
+    for run, add_after in segments:
+        for _ in range(run):
+            f, Rj = pairing._miller_double_step(f, Rj, xP, yP)
+        if add_after:
+            f, Rj = pairing._miller_add_step(f, Rj, Qa, Qj, xP, yP)
+    return f
+
+
+# A plan that exercises every structural feature: multiple runs of
+# different lengths, an addition between them, and a trailing no-add run.
+_SMALL_PLAN = ((1, True), (2, True), (3, False))
+
+
+def test_miller_full_kernel_small_plan(points):
+    P, Qa = points
+    want = _ref_miller_custom(_SMALL_PLAN, P, Qa)
+
+    xP, yP, _ = P
+    xQ, yQ, _ = Qa
+    lanes = 2
+    q = pairing_fused.pack_rows([xQ[0], xQ[1], yQ[0], yQ[1]], lanes)
+    pq = pairing_fused.pack_rows([xP, yP], lanes)
+    fold = jnp.asarray(pairing_fused._FOLD_T)
+    out = pairing_fused._miller_full_call(_SMALL_PLAN, 1, True)(q, pq, fold)
+    got = pairing_fused.unpack_f12(out, lanes)
+    for i in range(lanes):
+        assert tower.fq12_to_ints(got, i) == tower.fq12_to_ints(want, i)
+
+
+def test_pow_chain_kernel_small_exponent(points):
+    P, Qa = points
+    mw = pairing_fused.miller_loop(P, Qa)
+    # Easy part → a genuinely cyclotomic element.
+    m = tower.fq12_mul(tower.fq12_conj(mw), tower.fq12_inv(mw))
+    m = tower.fq12_mul(tower.fq12_frobenius_n(m, 2), m)
+    pm = pairing_fused.pack_rows(pairing_fused._leaves_f12(m), 2)
+    fold = jnp.asarray(pairing_fused._FOLD_T)
+
+    # exponent 0b1001101: runs+multiplies in every combination.
+    exp = 0b1001101
+    want = pm
+    for run, mult in pairing_fused._segments(exp):
+        want = pairing_fused._cyclo_run_call(run, 1, True)(want, fold)
+        if mult:
+            want = pairing_fused._mul12_call(1, True)(want, pm, fold)
+    got = pairing_fused._pow_chain_call(exp, 1, True)(pm, fold)
+    wu = pairing_fused.unpack_f12(want, 2)
+    gu = pairing_fused.unpack_f12(got, 2)
+    for i in range(2):
+        assert tower.fq12_to_ints(gu, i) == tower.fq12_to_ints(wu, i)
+
+
+@pytest.mark.skipif(
+    not os.environ.get("HBBFT_TPU_FUSE2_FULL_GOLDENS"),
+    reason="full 63-bit FUSE2 goldens take >1h in CPU interpret mode; "
+    "run with HBBFT_TPU_FUSE2_FULL_GOLDENS=1 before enabling FUSE2",
+)
+def test_fuse2_full_verification_end_to_end(monkeypatch):
+    """FE(ML(−G1, aG2)·ML(aG1, G2)) == 1 composed on the FUSE2 kernels."""
+    monkeypatch.setenv("HBBFT_TPU_FUSE2", "1")
+    args = pairing.example_verify_batch(2, distinct=2)
+    f = tower.fq12_mul(
+        pairing_fused.miller_loop(args[0], args[1]),
+        pairing_fused.miller_loop(args[2], args[3]),
+    )
+    out = pairing_fused.final_exp_fast(f)
+    for i in range(2):
+        assert pairing.is_one_host(out, i)
