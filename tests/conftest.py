@@ -48,26 +48,211 @@ def pytest_configure(config):
     config.addinivalue_line("markers", "slow: slow tests (Python pairings)")
 
 
-def pytest_collection_modifyitems(config, items):
-    """Run the heavy-XLA-compile tests FIRST.
+# ---------------------------------------------------------------------------
+# Subprocess isolation for the XLA:CPU-segfault-prone modules.
+#
+# The big RLC/pairing graph compiles crash the XLA:CPU compiler
+# NONDETERMINISTICALLY (observed at minute 15 of a fresh run and at minute
+# 66 of an ordered one; always inside backend_compile); heavy-first
+# ordering and the RLIMIT_STACK raise reduced but did not eliminate it.
+# Each module below therefore runs in its own young pytest subprocess —
+# one crash kills only that module's attempt, and a crashed attempt (rc
+# < 0 or 139/134) is retried once, converting the flaky crash into a
+# green run.  Per-test results are read back from junitxml and reported
+# into this session, so -x/-q/exit codes behave normally.
+# ---------------------------------------------------------------------------
 
-    XLA:CPU segfaults compiling the big RLC verification graphs late in
-    a long pytest process (observed 6/6 full-suite runs on 2026-07-30,
-    always at an RLC compile ~45 min in), while the same tests pass
-    consistently as young solo processes (3/3).  Whatever accumulated
-    process state triggers the compiler bug, compiling the big graphs
-    early — before hundreds of other compilations — avoids it.
-    """
-    heavy = (
-        "test_rlc_verify",
-        "test_tpu_backend",
-        "test_mesh_backend",
-        "test_honey_badger_tpu",
-        # big eager tower/pairing graphs; observed segfaulting ~66 min into
-        # a full run (2026-07-30) while passing consistently when young
-        "test_pairing_fused",
-        "test_curve_fused",
-    )
+_ISOLATE_DEFAULT = (
+    "tests/test_rlc_verify.py",
+    "tests/test_tpu_backend.py",
+    "tests/test_mesh_backend.py",
+    "tests/test_honey_badger_tpu.py",
+    "tests/test_pairing_fused.py",
+    "tests/test_pairing_fused2.py",
+    "tests/test_curve_fused.py",
+)
+
+
+def _isolate_modules():
+    env = os.environ.get("HBBFT_ISOLATE_MODULES")
+    if env is not None:
+        return tuple(m for m in env.split(",") if m)
+    return _ISOLATE_DEFAULT
+
+
+_isolated_selected = {}  # module path -> [nodeid, ...] selected in THIS run
+
+
+def pytest_collection_modifyitems(config, items):
+    """Run the heavy (isolated-subprocess) modules FIRST so their
+    failures surface early and the light tests stream afterwards; record
+    which of their tests survived -k/-m/nodeid selection so the
+    subprocess runs exactly those."""
+    heavy = tuple(os.path.basename(m).removesuffix(".py") for m in _isolate_modules())
     items.sort(
         key=lambda it: 0 if any(h in it.nodeid for h in heavy) else 1
     )
+    for it in items:
+        mod = _module_path(it)
+        if mod in _isolate_modules():
+            _isolated_selected.setdefault(mod, []).append(it.nodeid)
+
+
+_isolated_results = {}
+_isolated_ran = set()
+
+
+def _module_path(item) -> str:
+    path = item.nodeid.split("::")[0]
+    return path.replace(os.sep, "/")
+
+
+def _junit_key(nodeid: str) -> tuple:
+    """(classname, name) as pytest's junitxml records this nodeid:
+    'tests/test_x.py::TestFoo::test_bar[p]' →
+    ('tests.test_x.TestFoo', 'test_bar[p]')."""
+    parts = nodeid.split("::")
+    mod = parts[0].replace("/", ".").replace(os.sep, ".")
+    mod = mod.removesuffix(".py")
+    cls = ".".join([mod] + parts[1:-1])
+    return (cls, parts[-1])
+
+
+def _run_module_isolated(mod: str) -> None:
+    import subprocess
+    import tempfile
+    import xml.etree.ElementTree as ET
+
+    env = dict(os.environ)
+    env["HBBFT_ISOLATED"] = "1"
+    targets = _isolated_selected.get(mod) or [mod]
+    with tempfile.NamedTemporaryFile(suffix=".xml", delete=False) as tf:
+        xml_path = tf.name
+    try:
+        proc = None
+        timed_out = False
+        for attempt in (1, 2):
+            try:
+                proc = subprocess.run(
+                    [
+                        sys.executable,
+                        "-m",
+                        "pytest",
+                        *targets,
+                        "-q",
+                        "--tb=long",
+                        f"--junit-xml={xml_path}",
+                    ],
+                    cwd=_REPO,
+                    env=env,
+                    capture_output=True,
+                    text=True,
+                    timeout=5400,
+                )
+            except subprocess.TimeoutExpired:
+                # A hang would hang again — record, don't retry or raise
+                # (an uncaught exception here INTERNALERRORs the session).
+                timed_out = True
+                break
+            crashed = proc.returncode not in (0, 1, 2, 5)
+            if not crashed:
+                break
+            sys.stderr.write(
+                f"\n[conftest] isolated {mod} crashed "
+                f"(rc={proc.returncode}), attempt {attempt}/2\n"
+            )
+        if timed_out:
+            _isolated_results[mod] = (
+                "crashed",
+                f"isolated subprocess for {mod} exceeded 5400s (hung compile?)",
+                0.0,
+            )
+            return
+        tail = (proc.stdout + proc.stderr)[-8000:]
+        try:
+            tree = ET.parse(xml_path)
+        except ET.ParseError:
+            tree = None
+        if tree is not None:
+            for case in tree.iter("testcase"):
+                key = (case.get("classname", ""), case.get("name", ""))
+                dur = float(case.get("time") or 0.0)
+                if case.find("failure") is not None or case.find("error") is not None:
+                    el = case.find("failure")
+                    if el is None:
+                        el = case.find("error")
+                    _isolated_results[key] = (
+                        "failed",
+                        (el.get("message") or "") + "\n" + (el.text or ""),
+                        dur,
+                    )
+                elif case.find("skipped") is not None:
+                    el = case.find("skipped")
+                    _isolated_results[key] = (
+                        "skipped",
+                        el.get("message") or "skipped",
+                        dur,
+                    )
+                else:
+                    _isolated_results[key] = ("passed", "", dur)
+        crashed = proc.returncode not in (0, 1, 2, 5)
+        if crashed or tree is None:
+            _isolated_results[mod] = (
+                "crashed",
+                f"isolated subprocess rc={proc.returncode}\n{tail}",
+                0.0,
+            )
+    finally:
+        try:
+            os.unlink(xml_path)
+        except OSError:
+            pass
+
+
+def pytest_runtest_protocol(item, nextitem):
+    from _pytest.reports import TestReport
+
+    mod = _module_path(item)
+    if os.environ.get("HBBFT_ISOLATED") or mod not in _isolate_modules():
+        return None
+    if mod not in _isolated_ran:
+        _isolated_ran.add(mod)
+        _run_module_isolated(mod)
+
+    crash = _isolated_results.get(mod)
+    res = _isolated_results.get(_junit_key(item.nodeid))
+    if res is None:
+        # not in the junitxml (module crashed before reaching it)
+        res = (
+            "failed",
+            crash[1] if crash else "missing from isolated run",
+            0.0,
+        )
+    outcome, text, dur = res
+
+    item.ihook.pytest_runtest_logstart(
+        nodeid=item.nodeid, location=item.location
+    )
+    if outcome == "skipped":
+        longrepr = (mod, 0, text)
+    elif outcome == "failed":
+        longrepr = text
+    else:
+        longrepr = None
+    report = TestReport(
+        nodeid=item.nodeid,
+        location=item.location,
+        keywords={item.name: 1},
+        outcome=outcome if outcome != "crashed" else "failed",
+        longrepr=longrepr,
+        when="setup" if outcome == "skipped" else "call",
+        sections=[],
+        duration=dur,
+        start=0.0,
+        stop=dur,
+    )
+    item.ihook.pytest_runtest_logreport(report=report)
+    item.ihook.pytest_runtest_logfinish(
+        nodeid=item.nodeid, location=item.location
+    )
+    return True
