@@ -526,16 +526,23 @@ def _with_fallback(fn):
     """Run a bench metric; on failure retry on progressively more
     conservative kernel paths.
 
-    The fused Pallas kernels are golden-tested in interpret mode but a
-    first Mosaic compile on new hardware can still fail; without this, one
+    The Pallas kernels are golden-tested in interpret mode but a first
+    Mosaic compile on new hardware can still fail; without this, one
     rejected kernel turns the flagship metric into an error row.  Fallback
-    ladder: fused → unfused stacked kernels (HBBFT_TPU_NO_FUSED) → pure
-    XLA (HBBFT_TPU_NO_PALLAS).  The env is restored afterwards so every
+    ladder: requested path (default: unfused stacked kernels; fused is
+    opt-in via HBBFT_TPU_FUSED/FUSE2) → HBBFT_TPU_NO_FUSED (forces every
+    fused layer off, incl. the pow-chain kernel) → HBBFT_TPU_NO_MERGE
+    (also unstack the k-pair Miller merge) → pure XLA
+    (HBBFT_TPU_NO_PALLAS).  The env is restored afterwards so every
     metric independently attempts (and is labeled with) its own path;
     rungs whose variable was already set on entry are skipped as no-ops."""
     saved = {
         var: os.environ.get(var)
-        for var in ("HBBFT_TPU_NO_FUSED", "HBBFT_TPU_NO_PALLAS")
+        for var in (
+            "HBBFT_TPU_NO_FUSED",
+            "HBBFT_TPU_NO_MERGE",
+            "HBBFT_TPU_NO_PALLAS",
+        )
     }
     changed = False
     try:

@@ -166,11 +166,11 @@ def scalar_mul(F, bits: jnp.ndarray, P):
 
     Scalars must be pre-screened by `safe_scalar` (< 2^254, no ±1 prefix).
 
-    On TPU the whole ladder runs inside ONE Pallas kernel
-    (ops/curve_fused.py) — the scan form below dispatches ~8 stacked
-    multiplies per bit, which at ~100 µs fixed cost per call makes the
-    254-bit ladder >95% launch overhead (PERF.md).  The scan path stays
-    as the golden cross-check (HBBFT_TPU_NO_FUSED=1).
+    With HBBFT_TPU_FUSED=1 the whole ladder runs inside ONE Pallas kernel
+    (ops/curve_fused.py); the scan form below is the DEFAULT — the first
+    on-chip A/B (PERF.md "Round-2 sixth pass") measured it faster
+    (g2_sign 7,001/s vs the fused path trailing on every RLC metric),
+    the per-call-overhead model notwithstanding.
     """
     if jnp.ndim(bits) == 2:
         from hbbft_tpu.ops import curve_fused

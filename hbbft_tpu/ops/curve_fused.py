@@ -43,9 +43,11 @@ from hbbft_tpu.ops.pairing_fused import _algebra, _scratch
 
 
 def _use() -> bool:
-    if os.environ.get("HBBFT_TPU_NO_FUSED"):
-        return False
-    return fq._use_pallas()
+    # Opt-in; precedence rule lives in fq._use_fused.  The on-chip A/B
+    # (PERF.md "Round-2 sixth pass") measured the scan-form ladder faster
+    # than this fused kernel (g2_sign 7,001/s unfused; the fused default
+    # path trailed on every RLC metric).
+    return fq._use_fused()
 
 
 # ---------------------------------------------------------------------------

@@ -48,11 +48,9 @@ from hbbft_tpu.ops import curve, fq, tower
 
 def _use_fused() -> bool:
     """Route the Miller loop / final exp through the fused whole-block
-    Pallas kernels (ops/pairing_fused.py) on TPU.  The unfused stacked
-    path stays as the golden cross-check (HBBFT_TPU_NO_FUSED=1)."""
-    if os.environ.get("HBBFT_TPU_NO_FUSED"):
-        return False
-    return fq._use_pallas()
+    Pallas kernels (ops/pairing_fused.py) — opt-in; see fq._use_fused
+    for the precedence rule and the on-chip A/B that set it."""
+    return fq._use_fused()
 
 # Exponents for the final exponentiation.
 _EASY_DONE_HARD = (Q**4 - Q**2 + 1) // SUBGROUP_R
@@ -292,15 +290,16 @@ def miller_product(pairs):
 
     # The stacked scan needs every pair batched (rank ≥ 2 leaves) with one
     # common batch size; anything else falls back to sequential loops
-    # rather than silently concatenating along the wrong axis.  The
-    # HBBFT_TPU_NO_FUSED baseline switch also forces the sequential form so
-    # A/B runs compare against the true pre-merge graph.
+    # rather than silently concatenating along the wrong axis.  The merge
+    # is graph-level (a concatenate, no Pallas compile risk), so it has
+    # its own A/B switch (HBBFT_TPU_NO_MERGE=1) independent of the fused-
+    # kernel opt-in — lane-count scaling makes it a win on every path.
     ranks = {jnp.ndim(p[0][0]) for p in pairs}
     batches = {jnp.shape(p[0][0])[0] for p in pairs}
     if (
         ranks != {2}
         or len(batches) != 1
-        or os.environ.get("HBBFT_TPU_NO_FUSED")
+        or os.environ.get("HBBFT_TPU_NO_MERGE")
     ):
         f = None
         for P, Qa in pairs:

@@ -290,6 +290,11 @@ def _step_call(n_tiles: int, interpret: bool):
 
 
 def _fuse2() -> bool:
+    # Respect the fallback ladder: NO_FUSED / NO_PALLAS must win over the
+    # FUSE2 opt-in, or bench/driver retries on "a more conservative path"
+    # would recompile the exact kernel that just failed.
+    if os.environ.get("HBBFT_TPU_NO_FUSED") or os.environ.get("HBBFT_TPU_NO_PALLAS"):
+        return False
     return bool(os.environ.get("HBBFT_TPU_FUSE2"))
 
 
@@ -347,7 +352,15 @@ def _miller_full_kernel(segments, q_ref, pq_ref, fold_ref, out_ref, acc_ref=None
     yQ = (q_ref[2], q_ref[3])
 
     t = xP.shape[-1]
-    one = jnp.zeros((fq.NLIMBS, t), dtype=fq.DTYPE).at[0].set(1.0)
+    # Build the constant 1 without .at[].set — basic-index updates lower
+    # to the scatter primitive, which Mosaic's TPU lowering rejects.
+    one = jnp.concatenate(
+        [
+            jnp.ones((1, t), dtype=fq.DTYPE),
+            jnp.zeros((fq.NLIMBS - 1, t), dtype=fq.DTYPE),
+        ],
+        axis=0,
+    )
     zero = jnp.zeros((fq.NLIMBS, t), dtype=fq.DTYPE)
     f = tuple(
         tuple((one if (i, j, k) == (0, 0, 0) else zero) for k in (0, 1))
