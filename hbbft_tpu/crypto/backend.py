@@ -139,6 +139,19 @@ class CryptoBackend(abc.ABC):
             for shares, ct in items
         ]
 
+    def decrypt_shares_batch(
+        self, items: Sequence[Tuple[Any, Ciphertext]]
+    ) -> List[DecryptionShare]:
+        """Produce decryption shares for many (secret_key_share, ciphertext)
+        pairs at once — the share-GENERATION side of threshold decryption
+        (each item is one x_i·U scalar multiplication).
+
+        The whole-network simulation emits N² of these per epoch (every
+        node shares every accepted proposer's ciphertext); device backends
+        override with one batched ladder dispatch.
+        """
+        return [sk.decrypt_share_unchecked(ct) for sk, ct in items]
+
     # -- misc ----------------------------------------------------------------
 
     @property

@@ -271,13 +271,22 @@ class ArrayHoneyBadgerNet:
         assert all(ok), "array engine: honest ciphertext failed validation"
         rep.ciphertexts_verified += len(ct_items)
         # threshold_decrypt.py start_decryption: every node multicasts its
-        # decryption share for every accepted proposer.
+        # decryption share for every accepted proposer — all N² scalar
+        # mults through the backend's batched ladder (one device dispatch
+        # on TpuBackend).
+        gen_items = [
+            (self.netinfos[s].secret_key_share, cts[p])
+            for p in self.ids
+            for s in self.ids
+        ]
+        gen_out = self.backend.decrypt_shares_batch(gen_items)
         dec_shares: Dict[Any, Dict[int, Any]] = {}
+        pos = 0
         for p in self.ids:
             per_sender: Dict[int, Any] = {}
-            for s_idx, s in enumerate(self.ids):
-                sks = self.netinfos[s].secret_key_share
-                per_sender[s_idx] = sks.decrypt_share_unchecked(cts[p])
+            for s_idx in range(n):
+                per_sender[s_idx] = gen_out[pos]
+                pos += 1
             dec_shares[p] = per_sender
         self._count_msgs(rep, n * n * (n - 1))  # dec shares: Target.all
         rep.rounds += 1

@@ -384,19 +384,27 @@ def jac_to_affine_g2(P):
     return (x, y, inf)
 
 
+def g1_scalar_mul_signed(points, bits, negs):
+    """Batched ±(bits_i · P_i) ladders: the shared signed-ladder prologue
+    (`negs` is the (B,) bool safe_scalar negation mask)."""
+    prods = g1_scalar_mul_batch(points, bits)
+    return jac_select(_F1, jnp.asarray(negs), jac_neg(_F1, prods), prods)
+
+
+def g2_scalar_mul_signed(points, bits, negs):
+    prods = g2_scalar_mul_batch(points, bits)
+    return jac_select(_F2, jnp.asarray(negs), jac_neg(_F2, prods), prods)
+
+
 def linear_combine_g1(points, bits, negs):
     """Σ ±(bits_i · P_i) over the leading axis → single Jacobian point.
 
     `negs` is a (B,) bool array applying the safe_scalar negation.
     """
-    prods = g1_scalar_mul_batch(points, bits)
-    prods = jac_select(
-        _F1, jnp.asarray(negs), jac_neg(_F1, prods), prods
-    )
+    prods = g1_scalar_mul_signed(points, bits, negs)
     return _tree_sum(_F1, prods, jnp.shape(bits)[0])
 
 
 def linear_combine_g2(points, bits, negs):
-    prods = g2_scalar_mul_batch(points, bits)
-    prods = jac_select(_F2, jnp.asarray(negs), jac_neg(_F2, prods), prods)
+    prods = g2_scalar_mul_signed(points, bits, negs)
     return _tree_sum(_F2, prods, jnp.shape(bits)[0])

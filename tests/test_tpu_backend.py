@@ -167,3 +167,26 @@ def test_combine_dec_shares_batch_device_path(backend, keyset, rng):
         pks.combine_decryption_shares(shares, ct) for shares, ct in items
     ]
     assert host == msgs
+
+
+def test_decrypt_shares_batch_device_path(backend, keyset, rng):
+    """The batched G1 ladder share generation must match the host golden
+    decrypt_share_unchecked bit-for-bit (and actually dispatch once)."""
+    sks, pks = keyset
+    items = []
+    for j in range(3):
+        ct = pks.encrypt(bytes([70 + j]) * 9, rng)
+        for i in (0, 1, 2):
+            items.append((sks.secret_key_share(i), ct))
+    d0 = backend.counters.device_dispatches
+    backend.device_combine_threshold = 2  # force the device path
+    try:
+        got = backend.decrypt_shares_batch(items)
+    finally:
+        backend.device_combine_threshold = 8
+    assert backend.counters.device_dispatches == d0 + 1
+    want = [sk.decrypt_share_unchecked(ct) for sk, ct in items]
+    assert [g.el for g in got] == [w.el for w in want]
+    # and the shares actually decrypt: combine threshold+1 of them
+    shares = {i: got[i] for i in (0, 2)}
+    assert pks.combine_decryption_shares(shares, items[0][1]) == bytes([70]) * 9
