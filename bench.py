@@ -100,7 +100,9 @@ def _time_fn(fn, args, iters: int) -> float:
     out = None
     for c in copies:
         out = fn(*c)
-    np.asarray(jax.tree_util.tree_leaves(out)[0]).ravel()[:1]
+    # slice on DEVICE, then fetch one element: fencing with a whole-leaf
+    # transfer would bill a ~MB device→host copy to the kernel
+    np.asarray(jax.tree_util.tree_leaves(out)[0].ravel()[:1])
     return (time.perf_counter() - t0) / iters
 
 

@@ -33,6 +33,7 @@ only pays for itself in bulk.
 from __future__ import annotations
 
 import functools
+import os
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -211,14 +212,24 @@ class TpuBackend(CryptoBackend):
     # SURVEY.md §3.2 — is exactly this shape.)
 
     rlc_min_group = 3
-    RLC_BITS = 128
+    #: Random-coefficient width.  64 bits is the standard batch-verification
+    #: choice (blst's mult-verify and Ethereum consensus clients use 64-bit
+    #: random multipliers): a forged share survives a group check with
+    #: probability 2⁻⁶⁴ per attempt, and a failing group still falls back to
+    #: exact per-item checks, so soundness of fault ATTRIBUTION is never
+    #: probabilistic.  Halving the width halves the dominant per-share
+    #: device cost (the coefficient ladder).  HBBFT_TPU_RLC_BITS overrides
+    #: (e.g. 128 for the belt-and-braces setting).
+    RLC_BITS = int(os.environ.get("HBBFT_TPU_RLC_BITS", "64"))
 
     @staticmethod
     def _rlc_scalars(k: int) -> List[int]:
-        import os as _os
-
         top = (1 << TpuBackend.RLC_BITS) - 1
-        return [1 + int.from_bytes(_os.urandom(16), "big") % top for _ in range(k)]
+        nbytes = (TpuBackend.RLC_BITS + 7) // 8
+        return [
+            1 + int.from_bytes(os.urandom(nbytes), "big") % top
+            for _ in range(k)
+        ]
 
     @staticmethod
     def _reshape_groups(dev, g: int, k: int):

@@ -27,6 +27,7 @@ Reference analogue: group ops inside `threshold_crypto`'s `pairing` crate
 
 from __future__ import annotations
 
+import os
 from typing import Any, List, NamedTuple, Optional, Sequence, Tuple
 
 import numpy as np
@@ -178,6 +179,11 @@ def scalar_mul(F, bits: jnp.ndarray, P):
         if curve_fused._use():
             return curve_fused.scalar_mul(1 if F is _F1 else 2, bits, P)
 
+    if jnp.shape(bits)[-1] % 2 == 0 and not os.environ.get(
+        "HBBFT_TPU_LADDER_BINARY"
+    ):
+        return _scalar_mul_w2(F, bits, P)
+
     acc = infinity_like(F, P)
 
     def step(acc, bit):
@@ -189,6 +195,48 @@ def scalar_mul(F, bits: jnp.ndarray, P):
     # scan over the bit axis: move it to the front.
     xs = jnp.moveaxis(bits, -1, 0)
     acc, _ = jax.lax.scan(step, acc, xs)
+    return acc
+
+
+def _scalar_mul_w2(F, bits: jnp.ndarray, P):
+    """2-bit windowed MSB-first ladder: acc ← 4·acc + w·P per window,
+    w = 2·b_hi + b_lo ∈ {0..3} selected from precomputed {P, 2P, 3P}.
+
+    Halves the sequential scan length and replaces 2 conditional adds
+    with 1 per 2 bits: ~25% fewer point-ops than the binary ladder AND
+    half the per-step scan overhead (the dominant cost at RLC widths).
+
+    Unequal-add safety (same style as safe_scalar's argument): before a
+    window the accumulator is 4m·P with prefix m < 2^252 (a safe_scalar
+    input has < 2^254 bits, so the prefix before the last window is at
+    most 2^252−1).  A degenerate add needs 4m ≡ ±w (mod r) for the
+    selected w ∈ {1,2,3}: 4m = w is impossible (4 ∤ w, and m = 0 is the
+    explicit-infinity lane jac_add handles), and 4m = r−w needs
+    m ≥ (r−3)/4 > 2^252.8 — out of range.  The w = 0 lane executes a
+    dummy add of P whose (possibly degenerate) result is discarded by
+    the select; degenerate lanes are finite residues, never NaN/Inf.
+    Precompute: 3P = 2P + P is safe since 2 ≢ ±1 (mod r).
+    """
+    P2 = jac_double(F, P)
+    P3 = jac_add(F, P2, P)
+    acc = infinity_like(F, P)
+
+    def step(acc, bw):
+        hi, lo = bw
+        acc = jac_double(F, jac_double(F, acc))
+        T = jac_select(
+            F,
+            hi.astype(bool),
+            jac_select(F, lo.astype(bool), P3, P2),
+            P,
+        )
+        cand = jac_add(F, acc, T)
+        nz = (hi | lo).astype(bool)
+        return jac_select(F, nz, cand, acc), None
+
+    hi = jnp.moveaxis(bits[..., 0::2], -1, 0)
+    lo = jnp.moveaxis(bits[..., 1::2], -1, 0)
+    acc, _ = jax.lax.scan(step, acc, (hi, lo))
     return acc
 
 
