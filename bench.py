@@ -1,20 +1,21 @@
 """Benchmark entry point — prints one JSON line PER METRIC for the driver.
 
-Flagship metric (printed first): **threshold-share verifications/sec** on
-device — each item is a full BLS12-381 pairing-equation check
-e(a1,b1)==e(a2,b2) done as two Miller loops + one shared (fast) final
-exponentiation, batched over the work-item axis (BASELINE.json:
-"threshold-decrypt shares verified/sec/chip" is the operative micro-metric;
-the O(N²) such checks per epoch are the whole HBBFT performance story,
-SURVEY.md §3.2).
+Flagship metric (printed first): ``rlc_dec_verify_throughput`` —
+**threshold-decrypt shares verified/sec/chip**, BASELINE.json's operative
+micro-metric, measured through the REAL backend kernel (grouped
+random-linear-combination verification at the config-1 shape: 64
+ciphertext groups × 16 shares).  The O(N²) such checks per epoch are the
+whole HBBFT performance story (SURVEY.md §3.2).
 
-Further metrics cover the remaining BASELINE.json configs:
+Further metrics:
 
-* ``rlc_sig_verify_throughput``  — grouped (random-linear-combination)
-  sig-share verification at the common-coin shape (config 2: N=64-ish
-  coin instances × shares each); items/sec through the REAL backend kernel.
-* ``rlc_dec_verify_throughput``  — same for decryption shares at the
-  1k-ciphertext batch shape (config 1: N=16, 1k ciphertexts).
+* ``share_verify_throughput``    — full BLS12-381 pairing-equation checks
+  e(a1,b1)==e(a2,b2) (two Miller loops + one shared fast final
+  exponentiation per item): the general path used where shares check
+  against distinct documents (and rounds 1-6's flagship line, kept for
+  continuity).
+* ``rlc_sig_verify_throughput``  — grouped sig-share verification at the
+  common-coin shape (config 2: N=64-ish coin instances × shares each).
 * ``g2_sign_throughput``         — batched 254-bit G2 ladders (the sign op
   behind "10k coin flips vmapped", config 2).
 * ``rs_encode_throughput``       — GF(2⁸) Reed–Solomon parity as int8 MXU
@@ -647,8 +648,8 @@ def main() -> None:
     else:
         only = None
     extra = [
+        ("share_verify", bench_share_verify),
         ("rlc_sig", bench_rlc_sig),
-        ("rlc_dec", bench_rlc_dec),
         ("g2_sign", bench_g2_sign),
         ("rs_encode", bench_rs_encode),
     ]
@@ -668,7 +669,7 @@ def main() -> None:
     import jax
 
     platform = jax.default_backend()
-    for name, fn in [("share_verify", bench_share_verify)] + extra:
+    for name, fn in [("rlc_dec", bench_rlc_dec)] + extra:
         if only is not None and name not in only:
             continue
         try:

@@ -149,6 +149,11 @@ class TpuBackend(CryptoBackend):
 
     #: combine on device only when at least this many shares are batched
     device_combine_threshold = 8
+    #: Max ladder lanes (items × shares) per combine/generation dispatch.
+    #: One graph holding the whole batch's Jacobian ladder state scales
+    #: HBM linearly with lanes: 557k lanes (10k combines × k=34 at N=100)
+    #: needed 16.4 GB against the v5e's 15.75 GB.  32k lanes ≈ 1 GB.
+    device_lane_cap = 1 << 15
 
     def __init__(self) -> None:
         super().__init__(BLS381Group())
@@ -537,47 +542,58 @@ class TpuBackend(CryptoBackend):
                     f"need {pk_set.threshold() + 1} shares, got {len(shares)}"
                 )
             by_k.setdefault(len(shares), []).append(idx)
-        g = self.group
-        for k, idxs in by_k.items():
-            self.counters.dec_shares_combined += k * len(idxs)
+        for k, all_idxs in by_k.items():
+            self.counters.dec_shares_combined += k * len(all_idxs)
             # Gate on TOTAL ladder lanes (k shares × batch items), not the
             # per-item share count: at N=16 every item has k=f+1=6 shares
             # and a per-item gate would push 256-item batches through the
             # host loop one combine at a time (measured 14.5 s/epoch).
-            if k * len(idxs) < self.device_combine_threshold:
-                for idx in idxs:
+            if k * len(all_idxs) < self.device_combine_threshold:
+                for idx in all_idxs:
                     shares, ct = items[idx]
                     out[idx] = pk_set.combine_decryption_shares(shares, ct)
                 continue
-            b = self._pad_bucket(len(idxs))
-            flat_pts: List[Any] = []
-            bits_rows = []
-            negs_rows = []
-            for idx in idxs:
-                shares, _ct = items[idx]
-                srt = sorted(shares.items())
-                lam = lagrange_coeffs_at_zero([i + 1 for i, _ in srt])
-                safe = [curve.safe_scalar(l) for l in lam]
-                flat_pts.extend(s.el for _, s in srt)
-                bits_rows.append(curve.scalars_to_bits([s for s, _ in safe]))
-                negs_rows.append([n for _, n in safe])
-            # pad item axis with copies of the first item (discarded)
-            pad = b - len(idxs)
-            flat_pts.extend(flat_pts[:k] * pad)
-            bits_rows.extend([bits_rows[0]] * pad)
-            negs_rows.extend([negs_rows[0]] * pad)
-            P = curve.g1_to_device(flat_pts)
-            P = jax.tree_util.tree_map(
-                lambda c: jnp.reshape(c, (b, k) + c.shape[1:]), P
-            )
-            bits = jnp.asarray(np.stack(bits_rows))
-            negs = jnp.asarray(np.array(negs_rows))
-            self.counters.device_dispatches += 1
-            combined = _jitted_combine_g1_batch()(*self._place((P, bits, negs)))
-            els = curve.g1_from_device(_squeeze_point(combined))
-            for idx, el in zip(idxs, els[: len(idxs)]):
-                out[idx] = self._plaintext_from_combined(el, items[idx][1])
+            # lane-capped chunks: one oversized graph OOMs HBM (see
+            # device_lane_cap).  Power-of-two step so _pad_bucket's
+            # round-up can't overshoot the cap or waste lanes on padding.
+            step = max(1, self.device_lane_cap // k)
+            if step & (step - 1):
+                step = 1 << (step.bit_length() - 1)
+            for lo in range(0, len(all_idxs), step):
+                self._combine_dec_chunk(
+                    pk_set, items, all_idxs[lo : lo + step], k, out
+                )
         return out  # type: ignore[return-value]
+
+    def _combine_dec_chunk(self, pk_set, items, idxs, k, out) -> None:
+        b = self._pad_bucket(len(idxs))
+        flat_pts: List[Any] = []
+        bits_rows = []
+        negs_rows = []
+        for idx in idxs:
+            shares, _ct = items[idx]
+            srt = sorted(shares.items())
+            lam = lagrange_coeffs_at_zero([i + 1 for i, _ in srt])
+            safe = [curve.safe_scalar(l) for l in lam]
+            flat_pts.extend(s.el for _, s in srt)
+            bits_rows.append(curve.scalars_to_bits([s for s, _ in safe]))
+            negs_rows.append([n for _, n in safe])
+        # pad item axis with copies of the first item (discarded)
+        pad = b - len(idxs)
+        flat_pts.extend(flat_pts[:k] * pad)
+        bits_rows.extend([bits_rows[0]] * pad)
+        negs_rows.extend([negs_rows[0]] * pad)
+        P = curve.g1_to_device(flat_pts)
+        P = jax.tree_util.tree_map(
+            lambda c: jnp.reshape(c, (b, k) + c.shape[1:]), P
+        )
+        bits = jnp.asarray(np.stack(bits_rows))
+        negs = jnp.asarray(np.array(negs_rows))
+        self.counters.device_dispatches += 1
+        combined = _jitted_combine_g1_batch()(*self._place((P, bits, negs)))
+        els = curve.g1_from_device(_squeeze_point(combined))
+        for idx, el in zip(idxs, els[: len(idxs)]):
+            out[idx] = self._plaintext_from_combined(el, items[idx][1])
 
     def decrypt_shares_batch(
         self, items: Sequence[Tuple[Any, Ciphertext]]
@@ -589,6 +605,13 @@ class TpuBackend(CryptoBackend):
         n = len(items)
         if n < self.device_combine_threshold:
             return [sk.decrypt_share_unchecked(ct) for sk, ct in items]
+        if n > self.device_lane_cap:  # lane-capped chunks (HBM bound)
+            out: List[DecryptionShare] = []
+            for lo in range(0, n, self.device_lane_cap):
+                out.extend(
+                    self.decrypt_shares_batch(items[lo : lo + self.device_lane_cap])
+                )
+            return out
         b = self._pad_bucket(n)
         safe = [curve.safe_scalar(sk.x) for sk, _ in items]
         bits = curve.scalars_to_bits([s for s, _ in safe])

@@ -262,19 +262,25 @@ def safe_scalar(s: int) -> Tuple[int, bool]:
 
 
 def scalars_to_bits(scalars: Sequence[int], width: int = SCALAR_BITS) -> np.ndarray:
-    """(B, width) MSB-first bit matrix (host).
+    """(B, width) MSB-first bit matrix (host; vectorized via unpackbits).
 
-    A narrower width (e.g. 128 for random-linear-combination coefficients)
+    A narrower width (e.g. 64 for random-linear-combination coefficients)
     shortens the device ladder proportionally; any scalar < 2^width < 2^254
     is automatically ladder-safe (see safe_scalar).
     """
-    out = np.zeros((len(scalars), width), dtype=np.int32)
-    for i, s in enumerate(scalars):
+    if not scalars:
+        return np.zeros((0, width), dtype=np.int32)
+    nbytes = (width + 7) // 8
+    rows = []
+    for s in scalars:
         if s >> width:
             raise ValueError("scalar too large for bit width")
-        for j in range(width):
-            out[i, width - 1 - j] = (s >> j) & 1
-    return out
+        rows.append(int(s).to_bytes(nbytes, "big"))
+    buf = np.frombuffer(b"".join(rows), dtype=np.uint8).reshape(
+        len(scalars), nbytes
+    )
+    bits = np.unpackbits(buf, axis=1)[:, 8 * nbytes - width :]
+    return bits.astype(np.int32)
 
 
 # ---------------------------------------------------------------------------
@@ -284,11 +290,12 @@ def scalars_to_bits(scalars: Sequence[int], width: int = SCALAR_BITS) -> np.ndar
 
 def g1_to_device(points: Sequence[Optional[Tuple[int, int]]]):
     """Affine G1 points (golden-ref (x, y) ints or None) → batched Jacobian."""
-    n = len(points)
     xs = fq.from_ints([(p[0] if p else 0) for p in points])
     ys = fq.from_ints([(p[1] if p else 1) for p in points])
-    zs = np.stack([np.asarray(fq.ZERO if p is None else fq.ONE) for p in points])
     inf = np.array([p is None for p in points])
+    zs = np.where(
+        inf[:, None], np.asarray(fq.ZERO), np.asarray(fq.ONE)
+    ).astype(np.asarray(fq.ONE).dtype)
     return (jnp.asarray(xs), jnp.asarray(ys), jnp.asarray(zs), jnp.asarray(inf))
 
 
