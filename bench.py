@@ -43,6 +43,7 @@ import sys
 import time
 
 CPU_BASELINE_CHECKS_PER_SEC = 1_000.0
+ARRAY_N16_METRIC = "array_epochs_per_sec_n16_realcrypto"
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
@@ -325,9 +326,9 @@ def bench_rs_encode() -> dict:
 
     data, parity = 34, 66  # N=100, f=33: N-2f data + 2f parity
     shard = _env_int("BENCH_RS_SHARD", 16384)
-    # cheap kernel: more iters amortize residual relay noise (BENCH_ITERS
-    # still wins when set — it is the documented global knob)
-    iters = _env_int("BENCH_ITERS", _env_int("BENCH_RS_ITERS", 20))
+    # cheap kernel: more iters amortize residual relay noise; the
+    # metric-specific knob wins over the global BENCH_ITERS
+    iters = _env_int("BENCH_RS_ITERS", _env_int("BENCH_ITERS", 20))
     codec = JaxRSCodec(data, parity)
     enc = jax.jit(codec.encode_matrix_fn())
     rng = np.random.default_rng(0)
@@ -488,7 +489,7 @@ def bench_array_engine_n16_tpu() -> dict:
     3.8k pairings/epoch at ~1k/s).  BENCH_ARRAY16_BACKEND overrides the
     backend (tpu default here)."""
     return _bench_array_engine(
-        "array_epochs_per_sec_n16_realcrypto",
+        ARRAY_N16_METRIC,
         n=16,
         epochs=_env_int("BENCH_ARRAY16_EPOCHS", 2),
         baseline_eps=0.25,
@@ -559,6 +560,7 @@ def _ensure_live_accelerator() -> None:
     env.pop("PALLAS_AXON_POOL_IPS", None)
     env["JAX_PLATFORMS"] = "cpu"
     env["BENCH_PLATFORM_CHECKED"] = "1"
+    env["BENCH_CPU_FALLBACK"] = "1"  # marks rows/shapes as degraded-mode
     os.execve(sys.executable, [sys.executable] + sys.argv, env)
 
 
@@ -688,8 +690,58 @@ def main() -> None:
     import jax
 
     platform = jax.default_backend()
+    cpu_fallback = bool(os.environ.get("BENCH_CPU_FALLBACK"))
+    if os.environ.get("BENCH_ARRAY_DEDUP"):
+        print(
+            json.dumps(
+                {
+                    "metric": "bench_note",
+                    "note": "BENCH_ARRAY_DEDUP no longer affects "
+                    "array_epochs_per_sec_n100; the memoizing variant is "
+                    "its own row (array_epochs_per_sec_n100_dedup)",
+                }
+            ),
+            flush=True,
+        )
+    if cpu_fallback:
+        # Accelerator unreachable (dead tunnel → _ensure_live_accelerator
+        # re-exec'd us on CPU): shrink shapes/iters so every metric still
+        # reports a labeled number without half-hour XLA:CPU compiles —
+        # and without the big-graph XLA:CPU segfault risk (PERF.md).
+        # Deliberate CPU runs (user-set JAX_PLATFORMS=cpu) keep full
+        # shapes; rows below embed batch/groups so shrinkage is visible.
+        for var, val in (
+            ("BENCH_ITERS", "1"),
+            ("BENCH_RS_ITERS", "2"),
+            ("BENCH_BATCH", "32"),
+            ("BENCH_RLC_GROUPS", "8"),
+            ("BENCH_RLC_K", "8"),
+            ("BENCH_DEC_GROUPS", "8"),
+            ("BENCH_SIGN_BATCH", "64"),
+            ("BENCH_RS_SHARD", "4096"),
+        ):
+            os.environ.setdefault(var, val)
     for name, fn in [("rlc_dec", bench_rlc_dec)] + extra:
         if only is not None and name not in only:
+            continue
+        if (
+            name == "array_n16_tpu"
+            and platform == "cpu"
+            and not os.environ.get("BENCH_ARRAY16_BACKEND")
+        ):
+            # TpuBackend on XLA:CPU compiles the whole RLC/ladder graph
+            # set at interpreter-crash-prone sizes for minutes; the mock
+            # macro rows still cover the end-to-end path.
+            print(
+                json.dumps(
+                    {
+                        "metric": ARRAY_N16_METRIC,
+                        "skipped": "accelerator unavailable",
+                        "platform": platform,
+                    }
+                ),
+                flush=True,
+            )
             continue
         try:
             row = _with_fallback(fn)
