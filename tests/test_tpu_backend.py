@@ -190,3 +190,31 @@ def test_decrypt_shares_batch_device_path(backend, keyset, rng):
     # and the shares actually decrypt: combine threshold+1 of them
     shares = {i: got[i] for i in (0, 2)}
     assert pks.combine_decryption_shares(shares, items[0][1]) == bytes([70]) * 9
+
+
+def test_combine_dec_shares_batch_lane_capped_chunks(backend, keyset, rng):
+    """A batch above device_lane_cap splits into several device chunks
+    (the N=100 full-workload shape OOMed HBM in one graph); every chunk
+    must still decrypt correctly and in order."""
+    sks, pks = keyset
+    items = []
+    msgs = []
+    for j in range(6):
+        msg = bytes([80 + j]) * 10
+        ct = pks.encrypt(msg, rng)
+        shares = {
+            i: sks.secret_key_share(i).decrypt_share_unchecked(ct)
+            for i in (0, 2)
+        }
+        items.append((shares, ct))
+        msgs.append(msg)
+    d0 = backend.counters.device_dispatches
+    saved = (backend.device_combine_threshold, backend.device_lane_cap)
+    backend.device_combine_threshold = 2
+    backend.device_lane_cap = 4  # k=2 -> 2 items per chunk -> 3 chunks
+    try:
+        got = backend.combine_dec_shares_batch(pks, items)
+    finally:
+        backend.device_combine_threshold, backend.device_lane_cap = saved
+    assert got == msgs
+    assert backend.counters.device_dispatches == d0 + 3
