@@ -144,3 +144,36 @@ def test_linear_combine_g2(rng):
     for l, p in zip(lam, pts):
         want = gold.ec_add(gold.FQ2, want, gold.ec_mul(gold.FQ2, l, p))
     assert got == want
+
+
+def test_windowed_and_binary_ladders_agree(monkeypatch):
+    """The 2-bit windowed ladder (default for even widths) and the binary
+    scan form (HBBFT_TPU_LADDER_BINARY=1) must produce identical points;
+    both golden-checked against the host reference."""
+    import random
+
+    import jax
+    import jax.numpy as jnp
+
+    from hbbft_tpu.crypto import bls381 as gold
+    from hbbft_tpu.ops import curve
+
+    rng = random.Random(41)
+    width = 16  # small width: cheap XLA:CPU compile, still even → windowed
+    scalars = [rng.randrange(1, 1 << width) for _ in range(3)] + [0]
+    bits = jnp.asarray(curve.scalars_to_bits(scalars, width))
+    P = curve.g1_to_device([gold.G1_GEN] * len(scalars))
+
+    # ambient flags would alias the two paths (both binary, or both fused)
+    monkeypatch.delenv("HBBFT_TPU_LADDER_BINARY", raising=False)
+    monkeypatch.delenv("HBBFT_TPU_FUSED", raising=False)
+    monkeypatch.delenv("HBBFT_TPU_FUSE2", raising=False)
+    windowed = curve.g1_from_device(jax.jit(curve.g1_scalar_mul_batch)(P, bits))
+    monkeypatch.setenv("HBBFT_TPU_LADDER_BINARY", "1")
+    binary = curve.g1_from_device(jax.jit(curve.g1_scalar_mul_batch)(P, bits))
+
+    want = [
+        gold.ec_mul(gold.FQ, s, gold.G1_GEN) if s else None for s in scalars
+    ]
+    assert windowed == want
+    assert binary == want
