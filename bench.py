@@ -196,7 +196,7 @@ def bench_rlc_sig() -> dict:
         [1 + i * 7919 + j for j in range(k)] for i in range(g)
     ]  # fixed nonzero coefficients (timing, not security)
     rbits = jnp.asarray(
-        np.stack([curve.scalars_to_bits(row, TpuBackend.RLC_BITS) for row in rs])
+        np.stack([curve.scalars_to_bits(row, TpuBackend._rlc_bits()) for row in rs])
     )
     fn = _jitted_rlc_sig()
     dt = _time_fn(fn, (S, PK, rbits, negG1, H), iters)
@@ -256,7 +256,7 @@ def bench_rlc_dec() -> dict:
     H = pairing.g2_affine_to_device([gold.G2_GEN] * g)
     rs = [[1 + i * 104729 + j for j in range(k)] for i in range(g)]
     rbits = jnp.asarray(
-        np.stack([curve.scalars_to_bits(row, TpuBackend.RLC_BITS) for row in rs])
+        np.stack([curve.scalars_to_bits(row, TpuBackend._rlc_bits()) for row in rs])
     )
     fn = _jitted_rlc_dec()
     dt = _time_fn(fn, (D, D, rbits, H, H), iters)

@@ -25,6 +25,7 @@ trips shared with the mock group's suite.
 
 from __future__ import annotations
 
+import functools
 import hashlib
 from typing import Any, List, Optional, Tuple
 
@@ -385,6 +386,109 @@ def g2_on_curve(p) -> bool:
 
 
 # ---------------------------------------------------------------------------
+# Subgroup membership (fast endomorphism checks).
+#
+# The device scalar ladders (ops/curve.py) carry unequal-add safety proofs
+# that hold only for points of order r, and pairing checks cannot see
+# cofactor-torsion components — so every point deserialized from untrusted
+# bytes must be confirmed to lie in the r-order subgroup before it reaches
+# a ladder (the reference's pairing crate enforces the same invariant in
+# its checked deserialization; SURVEY.md §2.2 threshold_crypto row).
+#
+# Full-order checks (r·P == ∞, 255-bit ladder) are the fallback; the fast
+# path uses the standard eigenvalue identities (Scott, "A note on group
+# membership tests for G1, G2 and GT on BLS pairing-friendly curves"):
+#   G1: φ(x, y) = (β·x, y) with β a primitive cube root of unity acts on
+#       the r-subgroup as multiplication by λ = x²−1 (λ³ ≡ 1 mod r since
+#       r = x⁴−x²+1)  →  check φ(P) == λ·P           (126-bit ladder)
+#   G2: ψ = twist∘Frobenius∘untwist acts on G2 as multiplication by the
+#       curve parameter x (q ≡ t−1 = x mod r)        (64-bit ladder)
+# Both identities are self-validated against the generators at import; if
+# the constant resolution ever failed we would fall back to the full-order
+# check rather than accept a wrong identity.
+# ---------------------------------------------------------------------------
+
+
+def _fq2_pow(a, e: int):
+    acc = FQ2_ONE
+    while e:
+        if e & 1:
+            acc = fq2_mul(acc, a)
+        a = fq2_sqr(a)
+        e >>= 1
+    return acc
+
+
+def _find_beta() -> int:
+    for base in (2, 3, 5, 7, 11, 13):
+        b = pow(base, (Q - 1) // 3, Q)
+        if b != 1:
+            return b
+    raise AssertionError("no cube non-residue found")
+
+
+_G1_LAMBDA = BLS_X * BLS_X - 1  # eigenvalue of φ on G1 (x² − 1 ≡ (−x²)² mod r)
+
+
+def _resolve_beta() -> Optional[int]:
+    """Pick the cube root of unity whose φ matches multiplication by λ on
+    the generator; None if neither candidate validates (then the
+    full-order fallback is used — correctness never depends on φ)."""
+    beta = _find_beta()
+    for b in (beta, beta * beta % Q):
+        if (b * G1_GEN[0] % Q, G1_GEN[1]) == ec_mul(FQ, _G1_LAMBDA, G1_GEN):
+            return b
+    return None
+
+
+_BETA = _resolve_beta()
+
+
+def g1_in_subgroup(p) -> bool:
+    """Order-r membership for an on-curve G1 point: φ(P) == λ·P."""
+    if p is None:
+        return True
+    if _BETA is None:  # pragma: no cover - β resolves for BLS12-381
+        return ec_mul(FQ, R, p) is None
+    return ((_BETA * p[0]) % Q, p[1]) == ec_mul(FQ, _G1_LAMBDA, p)
+
+
+def _resolve_psi():
+    """Pick the (c_x, c_y) pair for ψ(x, y) = (c_x·x̄, c_y·ȳ) by validating
+    ψ(G2_GEN) == x·G2_GEN; returns None if no candidate matches (then the
+    full-order fallback is used — correctness never depends on ψ)."""
+    t3 = _fq2_pow(fq2_mul_xi(FQ2_ONE), (Q - 1) // 3)  # (1+u)^((q-1)/3)
+    t2 = _fq2_pow(fq2_mul_xi(FQ2_ONE), (Q - 1) // 2)  # (1+u)^((q-1)/2)
+    want = ec_mul(FQ2, -BLS_X if BLS_X_IS_NEG else BLS_X, G2_GEN)
+    for cx, cy in (
+        (fq2_inv(t3), fq2_inv(t2)),
+        (t3, t2),
+        (fq2_conj(fq2_inv(t3)), fq2_conj(fq2_inv(t2))),
+        (fq2_conj(t3), fq2_conj(t2)),
+    ):
+        x, y = G2_GEN
+        if (fq2_mul(cx, fq2_conj(x)), fq2_mul(cy, fq2_conj(y))) == want:
+            return cx, cy
+    return None
+
+
+_PSI_CONSTS = _resolve_psi()
+_G2_EIGEN = -BLS_X if BLS_X_IS_NEG else BLS_X
+
+
+def g2_in_subgroup(p) -> bool:
+    """Order-r membership for an on-curve G2 point: ψ(P) == x·P."""
+    if p is None:
+        return True
+    if _PSI_CONSTS is None:  # pragma: no cover - ψ resolves for BLS12-381
+        return ec_mul(FQ2, R, p) is None
+    cx, cy = _PSI_CONSTS
+    x, y = p
+    psi = (fq2_mul(cx, fq2_conj(x)), fq2_mul(cy, fq2_conj(y)))
+    return psi == ec_mul(FQ2, _G2_EIGEN, p)
+
+
+# ---------------------------------------------------------------------------
 # Pairing: untwist → generic Miller loop over E(Fq12) → final exponentiation.
 # ---------------------------------------------------------------------------
 
@@ -522,7 +626,12 @@ def g1_to_bytes(p) -> bytes:
     return bytes(data)
 
 
+@functools.lru_cache(maxsize=16384)
 def g1_from_bytes(data: bytes):
+    """Checked deserialization: on-curve AND order-r (g1_in_subgroup) — the
+    device ladders' precondition. LRU'd because the protocol re-parses the
+    same ciphertext bytes N times per epoch (honey_badger.py decrypt setup)
+    and the subgroup ladder is ~17 ms of host Python."""
     if len(data) != 48:
         raise ValueError("G1 point must be 48 bytes")
     flags = data[0]
@@ -539,6 +648,8 @@ def g1_from_bytes(data: bytes):
     sign = (flags >> 5) & 1
     if (1 if y > (Q - 1) // 2 else 0) != sign:
         y = Q - y
+    if not g1_in_subgroup((x, y)):
+        raise ValueError("not in the r-order subgroup")
     return (x, y)
 
 
@@ -554,7 +665,9 @@ def g2_to_bytes(p) -> bytes:
     return bytes(data)
 
 
+@functools.lru_cache(maxsize=16384)
 def g2_from_bytes(data: bytes):
+    """Checked deserialization: on-curve AND order-r (g2_in_subgroup)."""
     if len(data) != 96:
         raise ValueError("G2 point must be 96 bytes")
     flags = data[0]
@@ -576,6 +689,8 @@ def g2_from_bytes(data: bytes):
     have = 1 if (y1, y0) > ((Q - y1) % Q, (Q - y0) % Q) else 0
     if have != sign:
         y = fq2_neg(y)
+    if not g2_in_subgroup((x, y)):
+        raise ValueError("not in the r-order subgroup")
     return (x, y)
 
 

@@ -208,11 +208,11 @@ class TpuBackend(CryptoBackend):
     # -- grouped (random-linear-combination) verification --------------------
     #
     # For k same-document shares, ONE check e(G1, Σr_iσ_i) == e(Σr_iPK_i, H)
-    # with unpredictable 128-bit r_i replaces k pairing checks: a forged
+    # with unpredictable RLC_BITS-wide r_i replaces k pairing checks: a forged
     # share survives only if Σ r_i·δ_i = 0 for its discrepancy δ — probability
-    # 2⁻¹²⁸ over r.  Cost per item falls from 2 Miller loops + FE to two
-    # 128-bit ladder lanes.  Groups that fail fall back to per-item checks,
-    # preserving exact fault attribution.  (This is the classic BLS batch
+    # 2^-RLC_BITS over r.  Cost per item falls from 2 Miller loops + FE to two
+    # RLC_BITS-wide ladder lanes.  Groups that fail fall back to per-item
+    # checks, preserving exact fault attribution.  (This is the classic BLS batch
     # verification; the common-coin workload — N shares per coin instance,
     # SURVEY.md §3.2 — is exactly this shape.)
 
@@ -224,13 +224,18 @@ class TpuBackend(CryptoBackend):
     #: exact per-item checks, so soundness of fault ATTRIBUTION is never
     #: probabilistic.  Halving the width halves the dominant per-share
     #: device cost (the coefficient ladder).  HBBFT_TPU_RLC_BITS overrides
-    #: (e.g. 128 for the belt-and-braces setting).
-    RLC_BITS = int(os.environ.get("HBBFT_TPU_RLC_BITS", "64"))
+    #: (e.g. 128 for the belt-and-braces setting) and is re-read per batch
+    #: so in-process A/Bs (bench fallback ladder) take effect immediately.
+
+    @classmethod
+    def _rlc_bits(cls) -> int:
+        return int(os.environ.get("HBBFT_TPU_RLC_BITS", "64"))
 
     @staticmethod
     def _rlc_scalars(k: int) -> List[int]:
-        top = (1 << TpuBackend.RLC_BITS) - 1
-        nbytes = (TpuBackend.RLC_BITS + 7) // 8
+        bits = TpuBackend._rlc_bits()
+        top = (1 << bits) - 1
+        nbytes = (bits + 7) // 8
         return [
             1 + int.from_bytes(os.urandom(nbytes), "big") % top
             for _ in range(k)
@@ -274,7 +279,7 @@ class TpuBackend(CryptoBackend):
             rs = self._rlc_scalars(k)
             scalars.append([r if idx is not None else 0 for r, idx in zip(rs, grp)])
         rbits = np.stack(
-            [curve.scalars_to_bits(row, self.RLC_BITS) for row in scalars]
+            [curve.scalars_to_bits(row, self._rlc_bits()) for row in scalars]
         )
 
         self.counters.rlc_groups += len(groups)
@@ -601,7 +606,13 @@ class TpuBackend(CryptoBackend):
         """All N² decrypt-share generations (x_i·U_p) in one batched G1
         ladder dispatch — the whole-network simulation's round-7 workload
         (host golden: ~9 ms per scalar mult; measured 4.4 s/epoch at N=16
-        before batching)."""
+        before batching).
+
+        Precondition: every ct.u has order r.  The device ladder's
+        unequal-add safety argument (ops/curve.py) holds only for order-r
+        points; this is guaranteed because encrypt() constructs u = rG1
+        and network-deserialized points pass the subgroup check in
+        bls381.g1_from_bytes (g1_in_subgroup)."""
         n = len(items)
         if n < self.device_combine_threshold:
             return [sk.decrypt_share_unchecked(ct) for sk, ct in items]

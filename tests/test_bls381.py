@@ -152,3 +152,51 @@ def test_plain_bls_signature_on_real_curve():
     sig = sk.sign(b"m")
     assert sk.public_key().verify(sig, b"m")
     assert not sk.public_key().verify(sig, b"n")
+
+
+def test_subgroup_membership_checks():
+    """The fast endomorphism checks (φ eigenvalue on G1, ψ eigenvalue on
+    G2) must accept order-r points and reject on-curve cofactor-torsion
+    points — the device-ladder precondition enforced at deserialization
+    (the reference's pairing crate makes the same guarantee in its
+    checked decode; SURVEY.md §2.2 threshold_crypto row)."""
+    rng = random.Random(11)
+    for _ in range(3):
+        k = rng.randrange(1, B.R)
+        assert B.g1_in_subgroup(B.ec_mul(B.FQ, k, B.G1_GEN))
+        assert B.g2_in_subgroup(B.ec_mul(B.FQ2, k, B.G2_GEN))
+    assert B.g1_in_subgroup(None) and B.g2_in_subgroup(None)
+
+    # on-curve G1 point with a cofactor component: x-search, no clearing
+    x = 1
+    while True:
+        y = B._fq_sqrt((x * x * x + B.G1_B) % B.Q)
+        if y is not None and B.ec_mul(B.FQ, B.R, (x, y)) is not None:
+            torsion1 = (x, y)
+            break
+        x += 1
+    assert B.g1_on_curve(torsion1)
+    assert not B.g1_in_subgroup(torsion1)
+    with pytest.raises(ValueError, match="subgroup"):
+        B.g1_from_bytes(B.g1_to_bytes(torsion1))
+
+    # same for G2 on the twist
+    b2 = B.fq2_scalar(B.fq2_mul_xi(B.FQ2_ONE), 4)
+    x0 = 1
+    while True:
+        xx = (x0, 0)
+        yy = B.fq2_sqrt(B.fq2_add(B.fq2_mul(B.fq2_sqr(xx), xx), b2))
+        if yy is not None and B.ec_mul(B.FQ2, B.R, (xx, yy)) is not None:
+            torsion2 = (xx, yy)
+            break
+        x0 += 1
+    assert B.g2_on_curve(torsion2)
+    assert not B.g2_in_subgroup(torsion2)
+    with pytest.raises(ValueError, match="subgroup"):
+        B.g2_from_bytes(B.g2_to_bytes(torsion2))
+
+    # round-trip of legitimate points still works through the check
+    p = B.ec_mul(B.FQ, 12345, B.G1_GEN)
+    assert B.g1_from_bytes(B.g1_to_bytes(p)) == p
+    q = B.ec_mul(B.FQ2, 54321, B.G2_GEN)
+    assert B.g2_from_bytes(B.g2_to_bytes(q)) == q

@@ -30,6 +30,19 @@ def keyset(backend, rng):
     return sks, sks.public_keys()
 
 
+@pytest.fixture(autouse=True)
+def _restore_backend_tuning(backend):
+    """Tests flip device_combine_threshold / device_lane_cap to force path
+    selection; restore the pre-test values so the module-shared backend
+    never leaks a tuned value into later tests (and in-test restores need
+    not hardcode the class default)."""
+    orig_threshold = backend.device_combine_threshold
+    orig_cap = backend.device_lane_cap
+    yield
+    backend.device_combine_threshold = orig_threshold
+    backend.device_lane_cap = orig_cap
+
+
 def test_verify_sig_shares_mixed(backend, keyset, rng):
     sks, pks = keyset
     doc = b"epoch-0-coin"
@@ -60,7 +73,6 @@ def test_combine_signatures_device_and_host(backend, keyset, rng):
     # device path
     backend.device_combine_threshold = 2
     sig_dev = backend.combine_signatures(pks, shares)
-    backend.device_combine_threshold = 8
     assert sig_host == sig_dev
     assert pks.public_key().verify(sig_dev, doc)
 
@@ -80,20 +92,14 @@ def test_combine_signatures_reverify_falls_back(backend, keyset, monkeypatch):
         backend, "_lagrange_device_g2", lambda pts: wrong_point
     )
     backend.device_combine_threshold = 2
-    try:
-        sig = backend.combine_signatures(pks, shares, doc=doc)
-    finally:
-        backend.device_combine_threshold = 8
+    sig = backend.combine_signatures(pks, shares, doc=doc)
     assert sig == want
     assert pks.public_key().verify(sig, doc)
 
     # Without the doc there is nothing to re-verify against: the corrupted
     # point passes through (documents why callers should pass doc).
     backend.device_combine_threshold = 2
-    try:
-        sig_noctx = backend.combine_signatures(pks, shares)
-    finally:
-        backend.device_combine_threshold = 8
+    sig_noctx = backend.combine_signatures(pks, shares)
     assert sig_noctx.el == wrong_point
 
 
@@ -120,7 +126,6 @@ def test_threshold_decryption_roundtrip(backend, keyset, rng):
     out_dev = backend.combine_decryption_shares(pks, shares, ct)
     backend.device_combine_threshold = 99
     out_host = backend.combine_decryption_shares(pks, shares, ct)
-    backend.device_combine_threshold = 8
     assert out_dev == out_host == msg
 
 
@@ -156,10 +161,7 @@ def test_combine_dec_shares_batch_device_path(backend, keyset, rng):
         msgs.append(msg)
     d0 = backend.counters.device_dispatches
     backend.device_combine_threshold = 2  # force the device batch path
-    try:
-        got = backend.combine_dec_shares_batch(pks, items)
-    finally:
-        backend.device_combine_threshold = 8
+    got = backend.combine_dec_shares_batch(pks, items)
     assert got == msgs
     assert backend.counters.device_dispatches == d0 + 1
     # generic loop (host golden) agrees
@@ -180,10 +182,7 @@ def test_decrypt_shares_batch_device_path(backend, keyset, rng):
             items.append((sks.secret_key_share(i), ct))
     d0 = backend.counters.device_dispatches
     backend.device_combine_threshold = 2  # force the device path
-    try:
-        got = backend.decrypt_shares_batch(items)
-    finally:
-        backend.device_combine_threshold = 8
+    got = backend.decrypt_shares_batch(items)
     assert backend.counters.device_dispatches == d0 + 1
     want = [sk.decrypt_share_unchecked(ct) for sk, ct in items]
     assert [g.el for g in got] == [w.el for w in want]
@@ -209,12 +208,8 @@ def test_combine_dec_shares_batch_lane_capped_chunks(backend, keyset, rng):
         items.append((shares, ct))
         msgs.append(msg)
     d0 = backend.counters.device_dispatches
-    saved = (backend.device_combine_threshold, backend.device_lane_cap)
     backend.device_combine_threshold = 2
     backend.device_lane_cap = 4  # k=2 -> 2 items per chunk -> 3 chunks
-    try:
-        got = backend.combine_dec_shares_batch(pks, items)
-    finally:
-        backend.device_combine_threshold, backend.device_lane_cap = saved
+    got = backend.combine_dec_shares_batch(pks, items)
     assert got == msgs
     assert backend.counters.device_dispatches == d0 + 3

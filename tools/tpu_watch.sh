@@ -1,0 +1,23 @@
+#!/bin/bash
+# Background TPU tunnel liveness watcher. Appends one line per probe to
+# /tmp/tpu_status.log; writes /tmp/tpu_alive when a probe succeeds so the
+# build session can grab a bench window immediately (VERDICT r2 Missing #1).
+# Success = a small device matmul completes and fetches within the timeout
+# (same discipline as __graft_entry__._accelerator_alive: only a hang
+# counts as dead; the platform may report "tpu" or "axon").
+while true; do
+  ts=$(date -u +%H:%M:%S)
+  out=$(timeout 120 python -c "
+import jax, numpy as np, jax.numpy as jnp
+v = float(np.asarray(jnp.ones((64,64)) @ jnp.ones((64,64)))[0][0])
+print('OK', jax.devices()[0].platform, v)
+" 2>/dev/null | grep '^OK' | head -1)
+  if [ -n "$out" ]; then
+    echo "$ts ALIVE $out" >> /tmp/tpu_status.log
+    touch /tmp/tpu_alive
+  else
+    echo "$ts dead" >> /tmp/tpu_status.log
+    rm -f /tmp/tpu_alive
+  fi
+  sleep 180
+done
