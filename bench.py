@@ -571,8 +571,6 @@ def _clear_kernel_caches() -> None:
 
     for modname in (
         "hbbft_tpu.ops.backend",
-        "hbbft_tpu.ops.curve_fused",
-        "hbbft_tpu.ops.pairing_fused",
         "hbbft_tpu.ops.fq_pallas",
         "hbbft_tpu.ops.pairing",
         "hbbft_tpu.ops.curve",
@@ -612,9 +610,9 @@ def _with_fallback(fn):
     The Pallas kernels are golden-tested in interpret mode but a first
     Mosaic compile on new hardware can still fail; without this, one
     rejected kernel turns the flagship metric into an error row.  Fallback
-    ladder: requested path (default: unfused stacked kernels; fused is
-    opt-in via HBBFT_TPU_FUSED/FUSE2) → HBBFT_TPU_NO_FUSED (forces every
-    fused layer off, incl. the pow-chain kernel) → HBBFT_TPU_NO_MERGE
+    ladder: requested path (stacked kernels + fused pow-chain) →
+    HBBFT_TPU_NO_FUSED (forces the fused pow-chain kernel off) →
+    HBBFT_TPU_NO_MERGE
     (also unstack the k-pair Miller merge) → pure XLA
     (HBBFT_TPU_NO_PALLAS).  The env is restored afterwards so every
     metric independently attempts (and is labeled with) its own path;

@@ -638,6 +638,9 @@ def g1_from_bytes(data: bytes):
     if not flags & 0b1000_0000:
         raise ValueError("uncompressed encoding unsupported")
     if flags & 0b0100_0000:
+        # canonical infinity: sign bit clear, all remaining bits zero
+        if flags != 0b1100_0000 or any(data[1:]):
+            raise ValueError("non-canonical infinity encoding")
         return None
     x = int.from_bytes(bytes([flags & 0b0001_1111]) + data[1:], "big")
     if x >= Q:
@@ -674,6 +677,9 @@ def g2_from_bytes(data: bytes):
     if not flags & 0b1000_0000:
         raise ValueError("uncompressed encoding unsupported")
     if flags & 0b0100_0000:
+        # canonical infinity: sign bit clear, all remaining bits zero
+        if flags != 0b1100_0000 or any(data[1:]):
+            raise ValueError("non-canonical infinity encoding")
         return None
     x1 = int.from_bytes(bytes([flags & 0b0001_1111]) + data[1:48], "big")
     x0 = int.from_bytes(data[48:], "big")

@@ -167,18 +167,10 @@ def scalar_mul(F, bits: jnp.ndarray, P):
 
     Scalars must be pre-screened by `safe_scalar` (< 2^254, no ±1 prefix).
 
-    With HBBFT_TPU_FUSED=1 the whole ladder runs inside ONE Pallas kernel
-    (ops/curve_fused.py); the scan form below is the DEFAULT — the first
-    on-chip A/B (PERF.md "Round-2 sixth pass") measured it faster
-    (g2_sign 7,001/s vs the fused path trailing on every RLC metric),
-    the per-call-overhead model notwithstanding.
+    (The round-2 fused whole-ladder Pallas kernel was deleted after its
+    on-chip A/B loss — PERF.md "Round-2 sixth pass": this scan form won
+    every RLC metric, g2_sign 7,001/s vs the fused path trailing.)
     """
-    if jnp.ndim(bits) == 2:
-        from hbbft_tpu.ops import curve_fused
-
-        if curve_fused._use():
-            return curve_fused.scalar_mul(1 if F is _F1 else 2, bits, P)
-
     if jnp.shape(bits)[-1] % 2 == 0 and not os.environ.get(
         "HBBFT_TPU_LADDER_BINARY"
     ):

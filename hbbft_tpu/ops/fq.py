@@ -256,22 +256,6 @@ def _use_pallas() -> bool:
         return False
 
 
-def _use_fused() -> bool:
-    """Single precedence rule for the fused whole-block Pallas kernels
-    (ops/pairing_fused.py, ops/curve_fused.py): opt-in via
-    HBBFT_TPU_FUSED=1 or HBBFT_TPU_FUSE2=1; HBBFT_TPU_NO_FUSED=1 vetoes
-    (so the fallback ladder can force the stacked kernels); requires the
-    Pallas path (so HBBFT_TPU_NO_PALLAS=1 also vetoes).  The first
-    on-chip A/B (PERF.md "Round-2 sixth pass") measured the unfused
-    stacked kernels 1.4× faster on the verification graph and ~2.6× on
-    the RLC paths, hence opt-in rather than default."""
-    if os.environ.get("HBBFT_TPU_NO_FUSED"):
-        return False
-    if not (os.environ.get("HBBFT_TPU_FUSED") or os.environ.get("HBBFT_TPU_FUSE2")):
-        return False
-    return _use_pallas()
-
-
 def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     """Full product + reduction.  Inputs may be lazy (limbs grown by a few
     chained adds); they are renormalized before the convolution."""

@@ -46,12 +46,6 @@ from hbbft_tpu.crypto.field import Q, R as SUBGROUP_R
 from hbbft_tpu.ops import curve, fq, tower
 
 
-def _use_fused() -> bool:
-    """Route the Miller loop / final exp through the fused whole-block
-    Pallas kernels (ops/pairing_fused.py) — opt-in; see fq._use_fused
-    for the precedence rule and the on-chip A/B that set it."""
-    return fq._use_fused()
-
 # Exponents for the final exponentiation.
 _EASY_DONE_HARD = (Q**4 - Q**2 + 1) // SUBGROUP_R
 
@@ -237,11 +231,6 @@ def miller_loop(P, Qa):
     segmented unrolling achieved the same arithmetic but blew the XLA
     CPU compiler up on larger composed graphs.)
     """
-    if _use_fused():
-        from hbbft_tpu.ops import pairing_fused
-
-        return pairing_fused.miller_loop(P, Qa)
-
     xP, yP, infP = P
     xQ, yQ, infQ = Qa
     batch_shape = jnp.asarray(xP).shape[:-1]
@@ -362,10 +351,6 @@ def final_exponentiation_fast(f):
     64-bit x-powers ≈ 5× cheaper than the plain 1270-bit scan).  Use
     `final_exponentiation` when the exact pairing VALUE matters.
     """
-    if _use_fused():
-        from hbbft_tpu.ops import pairing_fused
-
-        return pairing_fused.final_exp_fast(f)
     # easy part: f^((Q⁶−1)(Q²+1)) → cyclotomic subgroup
     m = tower.fq12_mul(tower.fq12_conj(f), tower.fq12_inv(f))
     m = tower.fq12_mul(tower.fq12_frobenius_n(m, 2), m)

@@ -67,9 +67,6 @@ _ISOLATE_DEFAULT = (
     "tests/test_tpu_backend.py",
     "tests/test_mesh_backend.py",
     "tests/test_honey_badger_tpu.py",
-    "tests/test_pairing_fused.py",
-    "tests/test_pairing_fused2.py",
-    "tests/test_curve_fused.py",
 )
 
 

@@ -200,3 +200,16 @@ def test_subgroup_membership_checks():
     assert B.g1_from_bytes(B.g1_to_bytes(p)) == p
     q = B.ec_mul(B.FQ2, 54321, B.G2_GEN)
     assert B.g2_from_bytes(B.g2_to_bytes(q)) == q
+
+
+def test_non_canonical_infinity_rejected():
+    """Infinity must have exactly one byte-level encoding (the reference's
+    checked decode rejects malleable encodings the same way)."""
+    assert B.g1_from_bytes(B.g1_to_bytes(None)) is None
+    assert B.g2_from_bytes(B.g2_to_bytes(None)) is None
+    bad1 = bytearray(B.g1_to_bytes(None)); bad1[-1] = 1
+    with pytest.raises(ValueError, match="canonical"):
+        B.g1_from_bytes(bytes(bad1))
+    bad2 = bytearray(B.g2_to_bytes(None)); bad2[0] |= 0b0010_0000  # sign bit
+    with pytest.raises(ValueError, match="canonical"):
+        B.g2_from_bytes(bytes(bad2))

@@ -164,10 +164,8 @@ def test_windowed_and_binary_ladders_agree(monkeypatch):
     bits = jnp.asarray(curve.scalars_to_bits(scalars, width))
     P = curve.g1_to_device([gold.G1_GEN] * len(scalars))
 
-    # ambient flags would alias the two paths (both binary, or both fused)
+    # an ambient flag would alias the two paths (both binary)
     monkeypatch.delenv("HBBFT_TPU_LADDER_BINARY", raising=False)
-    monkeypatch.delenv("HBBFT_TPU_FUSED", raising=False)
-    monkeypatch.delenv("HBBFT_TPU_FUSE2", raising=False)
     windowed = curve.g1_from_device(jax.jit(curve.g1_scalar_mul_batch)(P, bits))
     monkeypatch.setenv("HBBFT_TPU_LADDER_BINARY", "1")
     binary = curve.g1_from_device(jax.jit(curve.g1_scalar_mul_batch)(P, bits))

@@ -10,7 +10,9 @@ while true; do
   out=$(timeout 120 python -c "
 import jax, numpy as np, jax.numpy as jnp
 v = float(np.asarray(jnp.ones((64,64)) @ jnp.ones((64,64)))[0][0])
-print('OK', jax.devices()[0].platform, v)
+plat = jax.devices()[0].platform
+assert plat in ('tpu', 'axon'), plat  # a CPU fallback is NOT alive
+print('OK', plat, v)
 " 2>/dev/null | grep '^OK' | head -1)
   if [ -n "$out" ]; then
     echo "$ts ALIVE $out" >> /tmp/tpu_status.log
