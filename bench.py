@@ -276,6 +276,199 @@ def bench_rlc_dec() -> dict:
     }
 
 
+def bench_coin_e2e() -> dict:
+    """BASELINE config 2 end to end: the common coin as one pipeline —
+    batched G2 sign → grouped-RLC share verify → batched G2 Lagrange
+    combine → hash → coin bit — at N=64 f=21 (threshold_sign.py flow,
+    SURVEY.md §3.2 HOTTEST loop).  Work is the dedup'd network-wide flow
+    per flip: N signs, N share verifies (one RLC group), one combine of
+    f+1 shares, one parity.  (The per-receiver duplication rides the
+    array-engine coin macro row instead.)  Flip 0's bit is asserted
+    against the host golden combine.  BENCH_COIN_FLIPS scales (config 2
+    names 10k)."""
+    import random
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from hbbft_tpu.crypto import bls381 as gold
+    from hbbft_tpu.crypto.backend import CpuBackend
+    from hbbft_tpu.crypto.keys import SecretKeySet, Signature, SignatureShare
+    from hbbft_tpu.ops import curve, pairing
+    from hbbft_tpu.ops.backend import (
+        TpuBackend,
+        _jitted_combine_g2_batch,
+        _jitted_rlc_sig,
+        _squeeze_point,
+    )
+    from hbbft_tpu.crypto.field import lagrange_coeffs_at_zero
+
+    n = _env_int("BENCH_COIN_N", 64)
+    f = (n - 1) // 3  # 21
+    flips = _env_int("BENCH_COIN_FLIPS", 128)
+    iters = _env_int("BENCH_COIN_ITERS", 1)
+
+    g = CpuBackend().group
+    rng = random.Random(21)
+    sk_set = SecretKeySet.random(g, f, rng)
+    pk_set = sk_set.public_keys()
+    shares_sk = [sk_set.secret_key_share(i) for i in range(n)]
+    pk_els = [pk_set.public_key_share(i).el for i in range(n)]
+    safe = [curve.safe_scalar(sk.x) for sk in shares_sk]
+    sk_bits_1 = curve.scalars_to_bits([s for s, _ in safe])
+    sk_negs_1 = np.array([neg for _, neg in safe])
+
+    k = f + 1  # combine width
+    lam = lagrange_coeffs_at_zero(list(range(1, k + 1)))
+    lsafe = [curve.safe_scalar(l) for l in lam]
+    lam_bits = curve.scalars_to_bits([s for s, _ in lsafe])
+    lam_negs = np.array([negq for _, negq in lsafe])
+
+    sign_fn = jax.jit(curve.g2_scalar_mul_signed)
+    rlc_fn = _jitted_rlc_sig()
+    comb_fn = _jitted_combine_g2_batch()
+    neg_g1 = pairing.g1_affine_to_device(
+        [gold.ec_neg(gold.FQ, gold.G1_GEN)] * flips
+    )
+    PK_jac = curve.g1_to_device(pk_els * flips)
+    PK_jac = jax.tree_util.tree_map(
+        lambda c: c.reshape((flips, n) + c.shape[1:]), PK_jac
+    )
+
+    def flip_batch(epoch_tag: int):
+        # one distinct doc per flip (the real coin's per-instance nonce);
+        # host hash-to-G2 is part of the honest pipeline cost.
+        docs = [
+            b"coin:%d:%d" % (epoch_tag, i) for i in range(flips)
+        ]
+        H = [g.hash_to_g2(d) for d in docs]
+        H_rep = [h for h in H for _ in range(n)]  # sign points, flip-major
+        bits = np.tile(sk_bits_1, (flips, 1))
+        negs = np.tile(sk_negs_1, flips)
+        S = sign_fn(
+            curve.g2_to_device(H_rep), jnp.asarray(bits), jnp.asarray(negs)
+        )  # (flips*n,) signature shares, Jacobian
+        S_g = jax.tree_util.tree_map(
+            lambda c: c.reshape((flips, n) + c.shape[1:]), S
+        )
+        # grouped-RLC verify: one group per flip
+        rs = [TpuBackend._rlc_scalars(n) for _ in range(flips)]
+        rbits = jnp.asarray(
+            np.stack(
+                [curve.scalars_to_bits(r, TpuBackend._rlc_bits()) for r in rs]
+            )
+        )
+        H_aff = pairing.g2_affine_to_device(H)
+        fvals = rlc_fn(S_g, PK_jac, rbits, neg_g1, H_aff)
+        fvals = jax.tree_util.tree_map(np.asarray, fvals)
+        # combine f+1 shares per flip (lowest indices), then parity
+        S_k = jax.tree_util.tree_map(lambda c: c[:, :k], S_g)
+        cb = jnp.asarray(np.tile(lam_bits, (flips, 1, 1)))
+        cn = jnp.asarray(np.tile(lam_negs, (flips, 1)))
+        combined = comb_fn(S_k, cb, cn)
+        els = curve.g2_from_device(_squeeze_point(combined))
+        bits_out = []
+        for i in range(flips):
+            assert pairing.is_one_host(fvals, i), "coin share group failed"
+            bits_out.append(Signature(g, els[i]).parity())
+        return docs, bits_out
+
+    docs, bits_out = flip_batch(0)  # warm + correctness
+    # golden: host combine of flip 0 must yield the same coin bit
+    gold_shares = {
+        i: SignatureShare(g, g.g2_mul(shares_sk[i].x, g.hash_to_g2(docs[0])))
+        for i in range(k)
+    }
+    assert (
+        pk_set.combine_signatures(gold_shares).parity() == bits_out[0]
+    ), "coin bit mismatch vs host golden"
+
+    t0 = time.perf_counter()
+    for it in range(iters):
+        flip_batch(1 + it)
+    dt = (time.perf_counter() - t0) / iters
+
+    # single-core estimate: N G2 signs (~1.5ms) + N pairing verifies
+    # (~1ms) + combine ≈ 0.16 s/flip ≈ 6 flips/s.
+    fps = flips / dt
+    return {
+        "metric": "coin_flips_per_sec",
+        "value": round(fps, 2),
+        "unit": "flips/s",
+        "vs_baseline": round(fps / 6.0, 3),
+        "baseline": "estimated",
+        "flips": flips,
+        "n": n,
+        "signs_per_flip": n,
+        "verifies_per_flip": n,
+        "combine_width": k,
+    }
+
+
+def bench_rlc_dec_adversarial() -> dict:
+    """Grouped dec-share verification with 1-5% forged shares through the
+    REAL backend path (verify_dec_shares): group mismatch → bisection →
+    exact leaf pairings (ops/backend.py _grouped_rlc).  Measures the
+    adversarial-DoS resistance the per-item fallback lacked."""
+    import random
+
+    from hbbft_tpu.crypto.backend import CpuBackend
+    from hbbft_tpu.crypto.keys import SecretKeySet
+    from hbbft_tpu.ops.backend import TpuBackend
+
+    gct = _env_int("BENCH_ADV_GROUPS", 32)  # ciphertext groups
+    k = _env_int("BENCH_ADV_K", 16)  # shares each
+    frac = float(os.environ.get("BENCH_ADV_FRAC", "0.03"))
+
+    backend = TpuBackend()
+    g = backend.group
+    rng = random.Random(5)
+    sk_set = SecretKeySet.random(g, 5, rng)
+    pk_set = sk_set.public_keys()
+    sks = [sk_set.secret_key_share(i) for i in range(k)]
+    cts = [pk_set.encrypt(b"adv-%d" % i, rng) for i in range(gct)]
+    gen = backend.decrypt_shares_batch(
+        [(sks[s], cts[ci]) for ci in range(gct) for s in range(k)]
+    )
+    items = []
+    want = []
+    n_items = gct * k
+    n_bad = max(1, int(frac * n_items))
+    bad_at = set(rng.sample(range(n_items), n_bad))
+    pos = 0
+    for ci in range(gct):
+        for s in range(k):
+            share = gen[pos]
+            good = pos not in bad_at
+            if not good:  # forged: another sender's share for the same ct
+                share = gen[ci * k + (s + 1) % k]
+            items.append((pk_set.public_key_share(s), cts[ci], share))
+            want.append(good)
+            pos += 1
+
+    # warm (compiles the bisection shapes) + correctness
+    got = backend.verify_dec_shares(items)
+    assert got == want, "adversarial attribution wrong"
+    iters = _env_int("BENCH_ADV_ITERS", 2)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        backend.verify_dec_shares(items)
+    dt = (time.perf_counter() - t0) / iters
+    tput = n_items / dt
+    return {
+        "metric": "rlc_dec_verify_adversarial",
+        "value": round(tput, 2),
+        "unit": "shares/s",
+        "vs_baseline": round(tput / CPU_BASELINE_CHECKS_PER_SEC, 3),
+        "baseline": "estimated",
+        "batch": n_items,
+        "groups": gct,
+        "contaminated": n_bad,
+        "contamination_frac": round(n_bad / n_items, 4),
+    }
+
+
 def bench_g2_sign() -> dict:
     """Batched 254-bit G2 ladders — the sign op of vmapped coin flips."""
     import random
@@ -394,6 +587,11 @@ def bench_epochs_n100() -> dict:
         "baseline": "estimated",
         "epochs_measured": epochs,
         "backend": backend.name,
+        # This row measures the per-message OBJECT runtime — the
+        # correctness/adversarial harness.  The throughput story at this
+        # shape is array_epochs_per_sec_n100 (lockstep array engine).
+        "runtime": "object",
+        "role": "correctness-harness",
     }
 
 
@@ -406,36 +604,79 @@ def _bench_array_engine(
     dynamic: bool,
     backend_env: str = "BENCH_ARRAY_BACKEND",
     backend_default: str = "mock",
+    coin_rounds: int = 0,
+    churn_epochs: int = 0,
 ) -> dict:
     """Shared array-engine macro bench: warm one epoch (compile/caches),
-    then time ``epochs`` full-workload lockstep epochs at network size n."""
+    then time ``epochs`` full-workload lockstep epochs at network size n.
+
+    ``churn_epochs`` > 0 inserts that many mid-run era changes (vote →
+    SyncKeyGen DKG → new keys; SURVEY.md §3.4) at evenly spaced epochs;
+    the era-change cost is timed separately (``era_change_seconds``) and
+    excluded from epochs/s so the steady-state metric stays comparable
+    round over round.  Post-turnover epochs run under the NEW keys — the
+    engine's decrypt-equality asserts are the correctness check."""
     from examples.simulation import make_backend
     from hbbft_tpu.engine import ArrayHoneyBadgerNet
 
     backend = make_backend(os.environ.get(backend_env, backend_default))
     net = ArrayHoneyBadgerNet(
         range(n), backend=backend, seed=0, dedup_verifies=dedup,
-        dynamic=dynamic,
+        dynamic=dynamic, coin_rounds=coin_rounds,
     )
     net.run_epochs(1, payload_size=64)  # warm: compile/caches
+    # mid-run only: era changes need a preceding and a following epoch, so
+    # indices clamp to [1, epochs-1] and dedupe (epochs < 2 → no churn; the
+    # row's churn_epochs field reports what actually ran).
+    churn_at = (
+        sorted(
+            {
+                min(epochs - 1, max(1, (i + 1) * epochs // (churn_epochs + 1)))
+                for i in range(churn_epochs)
+            }
+        )
+        if churn_epochs and epochs >= 2
+        else []
+    )
+    churn_time = 0.0
     t0 = time.perf_counter()
-    net.run_epochs(epochs, payload_size=64)
-    dt = time.perf_counter() - t0
-    eps = epochs / dt if dt > 0 else 0.0
+    done = 0
+    for e in range(epochs):
+        if e in churn_at:
+            c0 = time.perf_counter()
+            net.era_change()
+            churn_time += time.perf_counter() - c0
+        net.run_epochs(1, payload_size=64)
+        done += 1
+    dt = (time.perf_counter() - t0) - churn_time
+    eps = done / dt if dt > 0 else 0.0
     rep = net.reports[-1]  # warm epoch guarantees one report even if epochs=0
-    return {
+    row = {
         "metric": metric,
         "value": round(eps, 5),
         "unit": "epochs/s",
         "vs_baseline": round(eps / baseline_eps, 3),
         "baseline": "estimated",
+        "runtime": "array",
         "backend": backend.name,
         "dedup": dedup,
         "dynamic": dynamic,
         "epochs": epochs,
+        "churn_epochs": len(net.churn_reports),
         "messages_per_epoch": rep.messages_delivered,
         "dec_share_verifies_per_epoch": rep.dec_shares_verified,
     }
+    if coin_rounds:
+        row["coin_rounds_per_ba"] = coin_rounds
+        row["coin_signs_per_epoch"] = rep.coin_signs
+        row["sig_share_verifies_per_epoch"] = rep.sig_shares_verified
+        row["sig_combines_per_epoch"] = rep.sig_combines
+    if net.churn_reports:
+        crep = net.churn_reports[0]
+        row["era_change_seconds"] = round(churn_time / len(net.churn_reports), 3)
+        row["era_change_kg_acks"] = crep.kg_acks_handled
+        row["era"] = net.era
+    return row
 
 
 def bench_array_engine_n100() -> dict:
@@ -450,14 +691,20 @@ def bench_array_engine_n100() -> dict:
     (array_epochs_per_sec_n100_dedup) so this one is always the full
     per-receiver workload.  BASELINE config 3 names DynamicHoneyBadger,
     so the DHB flavor is the default.  Estimated single-core reference
-    ≈ 0.1 epochs/s (BASELINE.md cost model)."""
+    ≈ 0.1 epochs/s (BASELINE.md cost model).
+
+    BASELINE config 3 defines this at 1k epochs; the default here runs
+    100 (BENCH_ARRAY_EPOCHS raises it — CPU-fallback mode shrinks to 2)
+    with ONE mid-run era change (vote → DKG → era; BENCH_ARRAY_CHURN),
+    timed separately in era_change_seconds."""
     return _bench_array_engine(
         "array_epochs_per_sec_n100",
         n=_env_int("BENCH_ARRAY_N", 100),
-        epochs=_env_int("BENCH_ARRAY_EPOCHS", 2),
+        epochs=_env_int("BENCH_ARRAY_EPOCHS", 100),
         baseline_eps=0.1,
         dedup=False,
         dynamic=os.environ.get("BENCH_ARRAY_DYNAMIC", "1") == "1",
+        churn_epochs=_env_int("BENCH_ARRAY_CHURN", 1),
     )
 
 
@@ -471,7 +718,7 @@ def bench_array_engine_n100_dedup() -> dict:
     return _bench_array_engine(
         "array_epochs_per_sec_n100_dedup",
         n=_env_int("BENCH_ARRAY_N", 100),
-        epochs=_env_int("BENCH_ARRAY_EPOCHS", 2),
+        epochs=_env_int("BENCH_ARRAY_EPOCHS", 100),
         baseline_eps=0.1,
         dedup=True,
         dynamic=os.environ.get("BENCH_ARRAY_DYNAMIC", "1") == "1",
@@ -504,15 +751,39 @@ def bench_array_engine_n256_soak() -> dict:
     """BASELINE config 5 (QHB N=256 f=85 sustained) through the array
     engine: full-workload lockstep epochs — 117M delivered messages, 16.7M
     dec-share verifies, 185M hashes each — as a sustained-throughput soak
-    point.  BENCH_SOAK_EPOCHS raises the horizon.  Baseline: the N=100
-    cost model scaled by (256/100)³ ≈ 16.8× → ≈ 0.006 epochs/s."""
+    point.  Default horizon 10 epochs (config 5 says "sustained";
+    CPU-fallback mode shrinks to 1).  Baseline: the N=100 cost model
+    scaled by (256/100)³ ≈ 16.8× → ≈ 0.006 epochs/s."""
     return _bench_array_engine(
         "array_epochs_per_sec_n256_soak",
         n=256,
-        epochs=_env_int("BENCH_SOAK_EPOCHS", 1),
+        epochs=_env_int("BENCH_SOAK_EPOCHS", 10),
         baseline_eps=0.006,
         dedup=False,
         dynamic=True,
+    )
+
+
+def bench_array_engine_n64_coin() -> dict:
+    """BASELINE config 2 as a MACRO config: N=64 f=21 lockstep epochs with
+    one REAL common-coin round per BA instance (split-input schedule, so
+    ThresholdSign traffic actually executes: batched G2 signs, grouped-RLC
+    share verifies, per-receiver f+1 Lagrange combines, parity agreement
+    asserted across receivers — engine/_coin_round).  Full per-receiver
+    workload; mock backend by default so the row measures the engine +
+    accounting (BENCH_COIN_MACRO_BACKEND=tpu for the device path).
+    Baseline: N=64 epoch ≈ 260k pairing-verifies (dec + coin) at ~1k/s
+    ≈ 0.004 epochs/s single-core."""
+    return _bench_array_engine(
+        "array_epochs_per_sec_n64_coin",
+        n=64,
+        epochs=_env_int("BENCH_COIN_MACRO_EPOCHS", 2),
+        baseline_eps=0.004,
+        dedup=False,
+        dynamic=True,
+        backend_env="BENCH_COIN_MACRO_BACKEND",
+        backend_default="mock",
+        coin_rounds=_env_int("BENCH_COIN_ROUNDS", 1),
     )
 
 
@@ -665,20 +936,27 @@ def main() -> None:
         only = set(os.environ["BENCH_ONLY"].split(","))
     else:
         only = None
+    # Ordered so the LAST line — the one a one-line reader (and the
+    # driver's "parsed" field) lands on — is the north-star metric,
+    # array_epochs_per_sec_n100.
     extra = [
         ("share_verify", bench_share_verify),
         ("rlc_sig", bench_rlc_sig),
         ("g2_sign", bench_g2_sign),
+        ("coin_e2e", bench_coin_e2e),
+        ("rlc_dec_adversarial", bench_rlc_dec_adversarial),
         ("rs_encode", bench_rs_encode),
     ]
-    if os.environ.get("BENCH_ARRAY", "1") != "0":
-        extra.append(("array_n100", bench_array_engine_n100))
-        extra.append(("array_n100_dedup", bench_array_engine_n100_dedup))
-        extra.append(("array_n16_tpu", bench_array_engine_n16_tpu))
-    if os.environ.get("BENCH_SOAK", "1") != "0":
-        extra.append(("array_n256_soak", bench_array_engine_n256_soak))
     if os.environ.get("BENCH_N100", "1") != "0":
         extra.append(("n100", bench_epochs_n100))
+    if os.environ.get("BENCH_ARRAY", "1") != "0":
+        extra.append(("array_n16_tpu", bench_array_engine_n16_tpu))
+        extra.append(("array_n64_coin", bench_array_engine_n64_coin))
+    if os.environ.get("BENCH_SOAK", "1") != "0":
+        extra.append(("array_n256_soak", bench_array_engine_n256_soak))
+    if os.environ.get("BENCH_ARRAY", "1") != "0":
+        extra.append(("array_n100_dedup", bench_array_engine_n100_dedup))
+        extra.append(("array_n100", bench_array_engine_n100))
 
     from hbbft_tpu.utils.jax_config import enable_compile_cache, raise_stack_limit
 
@@ -717,6 +995,14 @@ def main() -> None:
             ("BENCH_DEC_GROUPS", "8"),
             ("BENCH_SIGN_BATCH", "64"),
             ("BENCH_RS_SHARD", "4096"),
+            ("BENCH_COIN_FLIPS", "8"),
+            ("BENCH_COIN_N", "16"),
+            ("BENCH_ADV_GROUPS", "8"),
+            ("BENCH_ADV_K", "8"),
+            ("BENCH_ARRAY_EPOCHS", "2"),
+            ("BENCH_SOAK_EPOCHS", "1"),
+            ("BENCH_COIN_MACRO_EPOCHS", "1"),
+            ("BENCH_ARRAY_CHURN", "0"),
         ):
             os.environ.setdefault(var, val)
     for name, fn in [("rlc_dec", bench_rlc_dec)] + extra:

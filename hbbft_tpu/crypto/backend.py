@@ -139,6 +139,30 @@ class CryptoBackend(abc.ABC):
             for shares, ct in items
         ]
 
+    def sign_shares_batch(
+        self, items: Sequence[Tuple[Any, bytes]]
+    ) -> List[SignatureShare]:
+        """Produce signature shares for many (secret_key_share, doc) pairs
+        at once — the share-GENERATION side of the common coin (each item
+        is one x_i·H2(doc) G2 scalar multiplication; SURVEY.md §3.2 marks
+        the coin as the hottest loop).  Device backends override with one
+        batched ladder dispatch."""
+        return [sk.sign_share(doc) for sk, doc in items]
+
+    def combine_sig_shares_batch(
+        self,
+        pk_set: PublicKeySet,
+        items: Sequence[Tuple[Dict[int, SignatureShare], Optional[bytes]]],
+    ) -> List[Signature]:
+        """Combine many signature-share sets at once (each item: shares,
+        optional doc for the combined-signature re-verify).  Device
+        backends override with a batched G2 Lagrange dispatch; the default
+        is the per-item loop."""
+        return [
+            self.combine_signatures(pk_set, shares, doc=doc)
+            for shares, doc in items
+        ]
+
     def decrypt_shares_batch(
         self, items: Sequence[Tuple[Any, Ciphertext]]
     ) -> List[DecryptionShare]:

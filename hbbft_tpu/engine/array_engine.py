@@ -73,6 +73,13 @@ class EpochReport:
     combines: int = 0
     rs_encodes: int = 0
     rs_reconstructs: int = 0
+    coin_rounds: int = 0
+    coin_signs: int = 0
+    sig_shares_verified: int = 0
+    sig_combines: int = 0
+    votes_verified: int = 0
+    kg_parts_handled: int = 0
+    kg_acks_handled: int = 0
 
 
 class ArrayHoneyBadgerNet:
@@ -99,6 +106,7 @@ class ArrayHoneyBadgerNet:
         dedup_verifies: bool = False,
         verify_chunk: int = 1 << 17,
         dynamic: bool = False,
+        coin_rounds: int = 0,
     ) -> None:
         self.ids = sorted(node_ids)
         self.n = len(self.ids)
@@ -117,9 +125,23 @@ class ArrayHoneyBadgerNet:
         #: layer performs (dynamic_honey_badger.py _on_hb_batch) has zero
         #: items — the honest cost of DHB's steady state over HB.
         self.dynamic = dynamic
+        #: Real ThresholdSign coin rounds per BA instance per epoch.  The
+        #: honest lockstep schedule with unanimous inputs decides every BA
+        #: on the round-0 fixed coin (binary_agreement.py _fixed_coin), so
+        #: the default epoch carries no threshold-sign traffic.  With
+        #: coin_rounds=R > 0 the engine models the split-input schedule —
+        #: conf_values = {true, false} for R rounds, so each BA round
+        #: invokes the REAL coin (threshold_sign.py): every node signs the
+        #: round nonce, broadcasts its share, verifies every peer's share,
+        #: and Lagrange-combines f+1 — before the definite round decides.
+        #: This is BASELINE config 2's workload (SURVEY.md §3.2 hottest
+        #: loop) riding the macro epoch.
+        self.coin_rounds = coin_rounds
         self.epoch = 0
+        self.era = 0
         self.counters = Counters()
         self.reports: List[EpochReport] = []
+        self.churn_reports: List[EpochReport] = []
         any_info = self.netinfos[self.ids[0]]
         self.pk_set = any_info.public_key_set
         self.pk_master = self.pk_set.public_key()
@@ -250,11 +272,14 @@ class ArrayHoneyBadgerNet:
         self._count_msgs(rep, n * n * (n - 1))  # Conf
         rep.rounds += 1
 
-        # ------ round 6: Conf quorum → fixed coin (round 0 → true) --------
-        # binary_agreement.py _fixed_coin: round 0 coin is the constant
-        # true; conf_values = {true} is definite and equals the coin →
-        # decide(true) in the first BA round, no threshold-sign traffic.
-        # Every BA decides true → Subset accepts all N proposers.
+        # ------ round 6: Conf quorum → coin ---------------------------------
+        # binary_agreement.py: with unanimous inputs conf_values = {true}
+        # is definite and equals the round-0 fixed coin → decide(true)
+        # immediately, no threshold-sign traffic (coin_rounds == 0).  With
+        # coin_rounds=R the engine executes R REAL coin rounds first (the
+        # split-input schedule where conf_values stays {true, false}).
+        for r in range(self.coin_rounds):
+            self._coin_round(rep, round_no=r)
         self._count_msgs(rep, n * n * (n - 1))  # Term
         rep.rounds += 1
 
@@ -351,10 +376,195 @@ class ArrayHoneyBadgerNet:
         self.counters.cranks += rep.rounds
         return {nid: batch for nid in self.ids}
 
-    def run_epochs(self, k: int, payload_size: int = 128) -> List[Dict[Any, Batch]]:
-        """Run k epochs with synthetic per-node contributions."""
+    def _coin_round(self, rep: EpochReport, round_no: int) -> None:
+        """One real common-coin round across all N BA instances
+        (threshold_sign.py sign → verify → combine → parity, batched
+        network-wide; SURVEY.md §3.2 marks the share-verify as the
+        HOTTEST loop).
+
+        Per BA round the full SBV exchange repeats (BVal, Aux, Conf) and
+        then every node broadcasts its coin share — 4×N²(N−1) messages.
+        Crypto, batched through the backend seam:
+
+        * sign:    N shares per instance (one x_s·H2(doc_p) G2 ladder each)
+        * verify:  every receiver checks every OTHER sender's share
+                   (N·(N−1) per instance; dedup mode: one representative)
+        * combine: every receiver Lagrange-combines f+1 verified shares
+                   (N per instance; dedup: 1) and takes sig.parity()
+
+        All receivers must derive the SAME bit — asserted per instance.
+        """
+        n = self.n
+        docs = [
+            canonical.encode(("coin", self.epoch, p_idx, round_no))
+            for p_idx in range(n)
+        ]
+        # SBV re-exchange for this BA round, then the share broadcast.
+        self._count_msgs(rep, 4 * n * n * (n - 1))  # BVal, Aux, Conf, share
+        sign_items = [
+            (self.netinfos[s].secret_key_share, docs[p_idx])
+            for p_idx in range(n)
+            for s in self.ids
+        ]
+        shares_flat = self.backend.sign_shares_batch(sign_items)
+        rep.coin_signs += len(sign_items)
+        shares: List[Dict[int, Any]] = []
+        pos = 0
+        for p_idx in range(n):
+            shares.append({s_idx: shares_flat[pos + s_idx] for s_idx in range(n)})
+            pos += n
+        # per-receiver share verification (own share trusted).
+        reps = 1 if self.dedup_verifies else n - 1
+        items = []
+        for p_idx in range(n):
+            for s_idx in range(n):
+                item = (
+                    self.pk_set.public_key_share(s_idx),
+                    docs[p_idx],
+                    shares[p_idx][s_idx],
+                )
+                items.extend([item] * reps)
+        ok = self._verify_batch("sig", items)
+        assert all(ok), "array engine: honest coin share rejected"
+        rep.sig_shares_verified += len(items)
+        # per-receiver combine: receiver i uses the f+1 verified shares
+        # with the lowest indices starting at its own (subsets differ by
+        # receiver; the combined signature must not).
+        k = self.threshold + 1
+        combine_items = []
+        per_instance_slots: List[List[int]] = []
+        for p_idx in range(n):
+            slots = []
+            for recv in range(1 if self.dedup_verifies else n):
+                chosen = {
+                    (recv + j) % n: shares[p_idx][(recv + j) % n]
+                    for j in range(k)
+                }
+                slots.append(len(combine_items))
+                combine_items.append((chosen, None))
+            per_instance_slots.append(slots)
+        sigs = []
+        for i in range(0, len(combine_items), self.verify_chunk):
+            sigs.extend(
+                self.backend.combine_sig_shares_batch(
+                    self.pk_set, combine_items[i : i + self.verify_chunk]
+                )
+            )
+        rep.sig_combines += len(combine_items)
+        for p_idx in range(n):
+            bits = {sigs[slot].parity() for slot in per_instance_slots[p_idx]}
+            assert len(bits) == 1, "array engine: coin bit disagreement"
+        rep.coin_rounds += 1
+        rep.rounds += 1
+
+    def era_change(self) -> EpochReport:
+        """Mid-run validator turnover: vote → DKG → new era (SURVEY.md
+        §3.4), executed lockstep between epochs.
+
+        Models DynamicHoneyBadger's churn machinery at array-engine scale:
+
+        1. **Vote**: every node signs a Change vote with its per-node key
+           (votes.py); every receiver verifies every vote — one batched
+           ``verify_signatures`` call (N·(N−1) checks; dedup: N).
+        2. **DKG**: all N nodes run SyncKeyGen — every Part handled by
+           every node (N² handle_part, each decrypting + checking a
+           committed row), every Ack by every node (N³ value checks; this
+           O(N³) host cost is the real price of an era change and is what
+           the churn bench row measures).
+        3. **Era turnover**: each node's generate() must agree on the new
+           PublicKeySet; NetworkInfo is rebuilt with the new key shares,
+           era += 1.  The NEXT run_epoch's decrypt-equality asserts prove
+           consensus still holds under the new keys.
+
+        Returns the work report (also appended to ``churn_reports``).
+        """
+        n, f = self.n, self.f
+        rep = EpochReport(epoch=self.epoch)
+        g = self.backend.group
+
+        # 1) signed votes, batch-verified per receiver (ride inside one
+        # epoch's contributions, so no extra message rounds).
+        vote_doc = canonical.encode(("vote", self.era, "rotate-keys"))
+        vote_sigs = {
+            nid: self.netinfos[nid].secret_key.sign(vote_doc)
+            for nid in self.ids
+        }
+        reps = 1 if self.dedup_verifies else n - 1
+        pub_keys = self.netinfos[self.ids[0]].public_key_map()
+        vote_items = [
+            (pub_keys[nid], vote_doc, vote_sigs[nid])
+            for nid in self.ids
+            for _ in range(reps)
+        ]
+        ok = self.backend.verify_signatures(vote_items)
+        assert all(ok), "array engine: honest vote rejected"
+        rep.votes_verified += len(vote_items)
+
+        # 2) full SyncKeyGen among all N (lockstep Part then Ack phases).
+        from hbbft_tpu.protocols.sync_key_gen import SyncKeyGen
+
+        kgs: Dict[Any, SyncKeyGen] = {}
+        parts = {}
+        for nid in self.ids:
+            kg, part = SyncKeyGen.new(
+                nid, self.netinfos[nid].secret_key, pub_keys, f, self.rng, g
+            )
+            kgs[nid] = kg
+            parts[nid] = part
+        self._count_msgs(rep, n * (n - 1))  # Part: Target.All
+        acks = []
+        for proposer in self.ids:
+            for nid in self.ids:
+                out = kgs[nid].handle_part(proposer, parts[proposer], self.rng)
+                assert out.fault is None, out.fault
+                if out.ack is not None:
+                    acks.append((nid, out.ack))
+                rep.kg_parts_handled += 1
+        self._count_msgs(rep, n * n * (n - 1))  # Ack: Target.All per part
+        for acker, ack in acks:
+            for nid in self.ids:
+                out = kgs[nid].handle_ack(acker, ack)
+                assert out.fault is None, out.fault
+                rep.kg_acks_handled += 1
+        rep.rounds += 2
+
+        # 3) era turnover: everyone must derive the same key set.
+        results = {nid: kgs[nid].generate() for nid in self.ids}
+        first = results[self.ids[0]][0]
+        assert all(results[nid][0] == first for nid in self.ids), (
+            "array engine: DKG public key set disagreement"
+        )
+        secret_keys = {nid: self.netinfos[nid].secret_key for nid in self.ids}
+        self.netinfos = {
+            nid: NetworkInfo(
+                our_id=nid,
+                secret_key_share=results[nid][1],
+                public_key_set=first,
+                secret_key=secret_keys[nid],
+                public_keys=pub_keys,
+            )
+            for nid in self.ids
+        }
+        self.pk_set = first
+        self.pk_master = first.public_key()
+        self.threshold = first.threshold()
+        self.era += 1
+        self.churn_reports.append(rep)
+        return rep
+
+    def run_epochs(
+        self,
+        k: int,
+        payload_size: int = 128,
+        churn_at: Optional[Sequence[int]] = None,
+    ) -> List[Dict[Any, Batch]]:
+        """Run k epochs with synthetic per-node contributions; an
+        ``era_change()`` fires before each epoch index in ``churn_at``."""
+        churn = set(churn_at or ())
         out = []
-        for _ in range(k):
+        for i in range(k):
+            if i in churn:
+                self.era_change()
             contribs = {
                 nid: self.rng.getrandbits(8 * payload_size).to_bytes(
                     payload_size, "big"

@@ -95,6 +95,20 @@ def _jitted_g1_mul_batch():
     return jax.jit(curve.g1_scalar_mul_signed)
 
 
+@functools.lru_cache(maxsize=None)
+def _jitted_g2_mul_batch():
+    """Batched independent G2 ladders: the coin-sign generation shape
+    (x_i·H2(doc) per item; SURVEY.md §3.2 hottest loop)."""
+    return jax.jit(curve.g2_scalar_mul_signed)
+
+
+@functools.lru_cache(maxsize=None)
+def _jitted_combine_g2_batch():
+    """vmap of the G2 Lagrange combine over an item axis — the batched
+    signature-combination shape (B items × k shares each)."""
+    return jax.jit(jax.vmap(curve.linear_combine_g2, in_axes=(0, 0, 0)))
+
+
 def _squeeze_point(P):
     """(G, 1, ...) Jacobian from a vmapped combine → (G, ...)."""
     return jax.tree_util.tree_map(lambda c: c[:, 0], P)
@@ -262,43 +276,64 @@ class TpuBackend(CryptoBackend):
         constructs the jitted fn's inputs; padding inside each group uses
         (None point, scalar 0) lanes that contribute the identity.
         `direct_quad(item)` builds the per-item pairing quad for the exact
-        fallback when a group check fails (passed explicitly so concurrent
+        fallback on contaminated leaves (passed explicitly so concurrent
         sig/dec verifications on one backend can't cross wires).
+
+        A failing group is BISECTED: each half re-enters the next round's
+        single batched RLC dispatch with fresh coefficients, until halves
+        would drop below rlc_min_group — those leaves get exact per-item
+        pairing checks (one batched dispatch at the end).  Attribution
+        cost for c contaminated items among k is O(c·log k) group lanes +
+        O(c) exact pairings instead of k pairings, so a 1-5%-garbage
+        batch can no longer collapse verification to per-item cost (the
+        adversarial-DoS amplifier the round-2 verdict flagged).  Fault
+        attribution stays exact: False is only ever written by the
+        per-item pairing check.
         """
-        if not groups:
-            return
-        k = _bucket(max(len(g) for g in groups))
-        g = self._pad_bucket(len(groups))
-        pad_group = [None] * k
-        padded: List[List[Optional[int]]] = [
-            list(grp) + [None] * (k - len(grp)) for grp in groups
-        ] + [pad_group] * (g - len(groups))
+        pending = [list(grp) for grp in groups if grp]
+        direct_leaf: List[int] = []
+        while pending:
+            k = _bucket(max(len(grp) for grp in pending))
+            g = self._pad_bucket(len(pending))
+            pad_group = [None] * k
+            padded: List[List[Optional[int]]] = [
+                list(grp) + [None] * (k - len(grp)) for grp in pending
+            ] + [pad_group] * (g - len(pending))
 
-        scalars = []
-        for grp in padded:
-            rs = self._rlc_scalars(k)
-            scalars.append([r if idx is not None else 0 for r, idx in zip(rs, grp)])
-        rbits = np.stack(
-            [curve.scalars_to_bits(row, self._rlc_bits()) for row in scalars]
-        )
-
-        self.counters.rlc_groups += len(groups)
-        self.counters.device_dispatches += 1
-        args = build_group_arrays(padded, g, k)
-        placed = self._place(tuple(args) + (jnp.asarray(rbits),))
-        f = jitted(*placed)
-        f = jax.tree_util.tree_map(np.asarray, f)
-        for gi, grp in enumerate(groups):
-            if pairing.is_one_host(f, gi):
-                for idx in grp:
-                    results[idx] = True
-            else:
-                # Attribute faults exactly: per-item fallback.
-                sub = self._check_batch(
-                    [direct_quad(items[idx]) for idx in grp]
+            scalars = []
+            for grp in padded:
+                rs = self._rlc_scalars(k)
+                scalars.append(
+                    [r if idx is not None else 0 for r, idx in zip(rs, grp)]
                 )
-                for idx, ok in zip(grp, sub):
-                    results[idx] = ok
+            rbits = np.stack(
+                [curve.scalars_to_bits(row, self._rlc_bits()) for row in scalars]
+            )
+
+            self.counters.rlc_groups += len(pending)
+            self.counters.device_dispatches += 1
+            args = build_group_arrays(padded, g, k)
+            placed = self._place(tuple(args) + (jnp.asarray(rbits),))
+            f = jitted(*placed)
+            f = jax.tree_util.tree_map(np.asarray, f)
+            next_pending: List[List[int]] = []
+            for gi, grp in enumerate(pending):
+                if pairing.is_one_host(f, gi):
+                    for idx in grp:
+                        results[idx] = True
+                elif len(grp) < 2 * self.rlc_min_group:
+                    direct_leaf.extend(grp)
+                else:
+                    mid = len(grp) // 2
+                    next_pending.append(grp[:mid])
+                    next_pending.append(grp[mid:])
+            pending = next_pending
+        if direct_leaf:
+            sub = self._check_batch(
+                [direct_quad(items[idx]) for idx in direct_leaf]
+            )
+            for idx, ok in zip(direct_leaf, sub):
+                results[idx] = ok
 
     # -- batched verification ------------------------------------------------
 
@@ -558,12 +593,7 @@ class TpuBackend(CryptoBackend):
                     shares, ct = items[idx]
                     out[idx] = pk_set.combine_decryption_shares(shares, ct)
                 continue
-            # lane-capped chunks: one oversized graph OOMs HBM (see
-            # device_lane_cap).  Power-of-two step so _pad_bucket's
-            # round-up can't overshoot the cap or waste lanes on padding.
-            step = max(1, self.device_lane_cap // k)
-            if step & (step - 1):
-                step = 1 << (step.bit_length() - 1)
+            step = self._lane_capped_step(k)
             for lo in range(0, len(all_idxs), step):
                 self._combine_dec_chunk(
                     pk_set, items, all_idxs[lo : lo + step], k, out
@@ -571,34 +601,151 @@ class TpuBackend(CryptoBackend):
         return out  # type: ignore[return-value]
 
     def _combine_dec_chunk(self, pk_set, items, idxs, k, out) -> None:
-        b = self._pad_bucket(len(idxs))
+        combined = self._lagrange_chunk(
+            [items[idx][0] for idx in idxs],
+            k,
+            curve.g1_to_device,
+            _jitted_combine_g1_batch(),
+        )
+        els = curve.g1_from_device(_squeeze_point(combined))
+        for idx, el in zip(idxs, els[: len(idxs)]):
+            out[idx] = self._plaintext_from_combined(el, items[idx][1])
+
+    def sign_shares_batch(
+        self, items: Sequence[Tuple[Any, bytes]]
+    ) -> List[SignatureShare]:
+        """All coin-share generations (x_i·H2(doc)) in one batched G2
+        ladder dispatch — the sign side of BASELINE config 2 (N signs per
+        coin instance, N instances per epoch at the macro shapes).
+
+        H2(doc) has order r by construction (hash_to_g2 clears the
+        cofactor), satisfying the device ladder's precondition."""
+        n = len(items)
+        if n < self.device_combine_threshold:
+            return [sk.sign_share(doc) for sk, doc in items]
+        if n > self.device_lane_cap:  # lane-capped chunks (HBM bound)
+            out: List[SignatureShare] = []
+            for lo in range(0, n, self.device_lane_cap):
+                out.extend(
+                    self.sign_shares_batch(items[lo : lo + self.device_lane_cap])
+                )
+            return out
+        b = self._pad_bucket(n)
+        safe = [curve.safe_scalar(sk.x) for sk, _ in items]
+        bits = curve.scalars_to_bits([s for s, _ in safe])
+        negs = np.array([neg for _, neg in safe])
+        pts = [self._hash_g2(doc) for _, doc in items]
+        if b > n:
+            bits = np.concatenate([bits, np.repeat(bits[:1], b - n, axis=0)])
+            negs = np.concatenate([negs, np.repeat(negs[:1], b - n)])
+            pts = pts + [pts[0]] * (b - n)
+        P = curve.g2_to_device(pts)
+        self.counters.device_dispatches += 1
+        out = _jitted_g2_mul_batch()(
+            *self._place((P, jnp.asarray(bits), jnp.asarray(negs)))
+        )
+        els = curve.g2_from_device(out)[:n]
+        return [SignatureShare(self.group, el) for el in els]
+
+    def combine_sig_shares_batch(
+        self,
+        pk_set: PublicKeySet,
+        items: Sequence[Tuple[Dict[int, SignatureShare], Optional[bytes]]],
+    ) -> List[Signature]:
+        """All signature combines in ONE device dispatch per share-count
+        group — the combine side of BASELINE config 2 (every receiver
+        combines f+1 verified coin shares per instance).  Mirrors
+        combine_dec_shares_batch's grouping/lane-capping; items whose doc
+        is not None get a batched combined-signature re-verify against the
+        master public key, with host-golden fallback on mismatch (same
+        defense-in-depth contract as combine_signatures)."""
+        out: List[Optional[Signature]] = [None] * len(items)
+        by_k: Dict[int, List[int]] = {}
+        for idx, (shares, _doc) in enumerate(items):
+            if len(shares) <= pk_set.threshold():
+                raise CryptoError(
+                    f"need {pk_set.threshold() + 1} shares, got {len(shares)}"
+                )
+            by_k.setdefault(len(shares), []).append(idx)
+        device_idxs: List[int] = []
+        for k, all_idxs in by_k.items():
+            self.counters.sig_shares_combined += k * len(all_idxs)
+            if k * len(all_idxs) < self.device_combine_threshold:
+                for idx in all_idxs:
+                    shares, doc = items[idx]
+                    out[idx] = pk_set.combine_signatures(shares)
+                continue
+            device_idxs.extend(all_idxs)
+            step = self._lane_capped_step(k)
+            for lo in range(0, len(all_idxs), step):
+                self._combine_sig_chunk(
+                    pk_set, items, all_idxs[lo : lo + step], k, out
+                )
+        # Batched defense-in-depth for DEVICE-combined items only (the
+        # host path IS the golden combine — re-verifying it would just
+        # recompute itself on mismatch): one pairing per doc-carrying item.
+        check_idx = [i for i in device_idxs if items[i][1] is not None]
+        if check_idx:
+            g1 = self.group.g1()
+            pk = pk_set.public_key()
+            quads = [
+                (g1, out[i].el, pk.el, self._hash_g2(items[i][1]))
+                for i in check_idx
+            ]
+            ok = self._check_batch(quads)
+            for i, good in zip(check_idx, ok):
+                if not good:
+                    out[i] = pk_set.combine_signatures(items[i][0])
+        return out  # type: ignore[return-value]
+
+    def _lane_capped_step(self, k: int) -> int:
+        """Items per combine chunk: lane-capped (one oversized graph OOMs
+        HBM — see device_lane_cap), rounded down to a power of two so
+        _pad_bucket's round-up can't overshoot the cap or waste lanes on
+        padding."""
+        step = max(1, self.device_lane_cap // k)
+        if step & (step - 1):
+            step = 1 << (step.bit_length() - 1)
+        return step
+
+    def _lagrange_chunk(self, share_dicts, k, to_device, jitted):
+        """Shared chunk body for the batched Lagrange combines: (B, k)
+        point tree + per-item coefficient bit/neg rows, padded with copies
+        of the first item (discarded) to a power-of-two item bucket."""
+        b = self._pad_bucket(len(share_dicts))
         flat_pts: List[Any] = []
         bits_rows = []
         negs_rows = []
-        for idx in idxs:
-            shares, _ct = items[idx]
+        for shares in share_dicts:
             srt = sorted(shares.items())
             lam = lagrange_coeffs_at_zero([i + 1 for i, _ in srt])
             safe = [curve.safe_scalar(l) for l in lam]
             flat_pts.extend(s.el for _, s in srt)
             bits_rows.append(curve.scalars_to_bits([s for s, _ in safe]))
             negs_rows.append([n for _, n in safe])
-        # pad item axis with copies of the first item (discarded)
-        pad = b - len(idxs)
+        pad = b - len(share_dicts)
         flat_pts.extend(flat_pts[:k] * pad)
         bits_rows.extend([bits_rows[0]] * pad)
         negs_rows.extend([negs_rows[0]] * pad)
-        P = curve.g1_to_device(flat_pts)
+        P = to_device(flat_pts)
         P = jax.tree_util.tree_map(
             lambda c: jnp.reshape(c, (b, k) + c.shape[1:]), P
         )
         bits = jnp.asarray(np.stack(bits_rows))
         negs = jnp.asarray(np.array(negs_rows))
         self.counters.device_dispatches += 1
-        combined = _jitted_combine_g1_batch()(*self._place((P, bits, negs)))
-        els = curve.g1_from_device(_squeeze_point(combined))
+        return jitted(*self._place((P, bits, negs)))
+
+    def _combine_sig_chunk(self, pk_set, items, idxs, k, out) -> None:
+        combined = self._lagrange_chunk(
+            [items[idx][0] for idx in idxs],
+            k,
+            curve.g2_to_device,
+            _jitted_combine_g2_batch(),
+        )
+        els = curve.g2_from_device(_squeeze_point(combined))
         for idx, el in zip(idxs, els[: len(idxs)]):
-            out[idx] = self._plaintext_from_combined(el, items[idx][1])
+            out[idx] = Signature(self.group, el)
 
     def decrypt_shares_batch(
         self, items: Sequence[Tuple[Any, Ciphertext]]

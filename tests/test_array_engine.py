@@ -102,3 +102,75 @@ def test_dynamic_mode():
     hb = ArrayHoneyBadgerNet(ids, backend=MockBackend(), seed=2)
     dhb = ArrayHoneyBadgerNet(ids, backend=MockBackend(), seed=2, dynamic=True)
     assert hb.run_epoch(contribs)[0] == dhb.run_epoch(contribs)[0]
+
+
+def test_coin_rounds_mode():
+    """coin_rounds=R executes R real threshold-sign coin rounds per BA
+    instance (sign → verify → combine → parity; SURVEY.md §3.2 hottest
+    loop) and all receivers derive the same bit — batches unchanged."""
+    ids = range(7)
+    contribs = _contribs(list(ids))
+    plain = ArrayHoneyBadgerNet(ids, backend=MockBackend(), seed=3)
+    coin = ArrayHoneyBadgerNet(
+        ids, backend=MockBackend(), seed=3, coin_rounds=2
+    )
+    assert plain.run_epoch(contribs)[0] == coin.run_epoch(contribs)[0]
+    rep = coin.reports[-1]
+    n = 7
+    assert rep.coin_rounds == 2
+    assert rep.coin_signs == 2 * n * n
+    assert rep.sig_shares_verified == 2 * n * n * (n - 1)
+    assert rep.sig_combines == 2 * n * n
+    # coin rounds add 4 broadcast storms each (BVal, Aux, Conf, share)
+    assert (
+        rep.messages_delivered
+        == plain.reports[-1].messages_delivered + 2 * 4 * n * n * (n - 1)
+    )
+
+
+def test_coin_rounds_real_crypto_bit_agreement():
+    """Real-curve coin: receivers combine DIFFERENT f+1 share subsets;
+    signature uniqueness must give every receiver the same parity bit
+    (this is the unbiasable-coin property BinaryAgreement relies on)."""
+    from hbbft_tpu.crypto.backend import CpuBackend
+
+    ids = range(4)
+    net = ArrayHoneyBadgerNet(
+        ids, backend=CpuBackend(), seed=5, coin_rounds=1, dedup_verifies=True
+    )
+    net.run_epoch(_contribs(list(ids)))  # asserts bit agreement internally
+    assert net.reports[-1].coin_rounds == 1
+
+
+def test_era_change_turnover():
+    """vote → DKG → era (SURVEY.md §3.4): keys rotate, consensus still
+    holds post-turnover, old-key signatures stop verifying."""
+    ids = range(7)
+    net = ArrayHoneyBadgerNet(ids, backend=MockBackend(), seed=6)
+    pk0 = net.pk_set
+    sk0 = net.netinfos[0].secret_key_share
+    net.run_epochs(1, payload_size=8)
+    rep = net.era_change()
+    assert net.era == 1
+    assert net.pk_set != pk0
+    assert rep.kg_parts_handled == 49
+    assert rep.kg_acks_handled == 49 * 7
+    assert rep.votes_verified == 7 * 6
+    # epochs post-turnover still reach consensus (decrypt asserts inside)
+    out = net.run_epochs(2, payload_size=8)
+    assert out[0][0].contributions == out[0][3].contributions
+    # a share signed under the OLD keys fails against the NEW key set
+    doc = b"stale-era"
+    old_share = sk0.sign_share(doc)
+    assert net.backend.verify_sig_shares(
+        [(net.pk_set.public_key_share(0), doc, old_share)]
+    ) == [False]
+
+
+def test_run_epochs_churn_at():
+    ids = range(5)
+    net = ArrayHoneyBadgerNet(ids, backend=MockBackend(), seed=7)
+    net.run_epochs(3, payload_size=8, churn_at=[1, 2])
+    assert net.era == 2
+    assert len(net.churn_reports) == 2
+    assert len(net.reports) == 3

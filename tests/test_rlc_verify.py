@@ -89,3 +89,48 @@ def test_rlc_dec_shares(backend, keyset, rng):
     items.append((pks.public_key_share(5), ct, wrong))
     want.append(False)
     assert backend.verify_dec_shares(items) == want
+
+
+def test_rlc_bisection_attributes_exactly_with_log_pairings(backend, keyset):
+    """A contaminated group is bisected — halves re-checked by RLC, only
+    sub-rlc_min_group leaves get exact pairings — and attribution is still
+    exact.  With 1 forgery in 16 shares the exact-check bill must be the
+    leaf (≤4 items), not the whole group (the per-item fallback the
+    round-2 verdict flagged as an adversarial-DoS amplifier)."""
+    sks, pks = keyset
+    doc = b"coin-bisect"
+    items = []
+    want = []
+    bad_at = 9
+    for i in range(16):
+        share = sks.secret_key_share(i).sign_share(doc)
+        if i == bad_at:
+            share = sks.secret_key_share(i).sign_share(b"forged-doc")
+        items.append((pks.public_key_share(i), doc, share))
+        want.append(i != bad_at)
+    p0 = backend.counters.pairing_checks
+    r0 = backend.counters.rlc_groups
+    assert backend.verify_sig_shares(items) == want
+    exact_checks = backend.counters.pairing_checks - p0
+    assert 0 < exact_checks <= 4, exact_checks  # leaf only, not all 16
+    # bisection ran extra RLC rounds: 1 top + halves + quarters
+    assert backend.counters.rlc_groups - r0 >= 4
+
+
+def test_rlc_bisection_two_forgeries_opposite_halves(backend, keyset, rng):
+    """Forgeries in both halves force parallel bisection paths; both must
+    be attributed, everything else accepted (dec-share variant)."""
+    sks, pks = keyset
+    ct = pks.encrypt(b"bisect both halves", rng)
+    items = []
+    want = []
+    bad = {2, 13}
+    for i in range(16):
+        share = sks.secret_key_share(i).decrypt_share_unchecked(ct)
+        if i in bad:
+            share = sks.secret_key_share(15 - i).decrypt_share_unchecked(ct)
+        items.append((pks.public_key_share(i), ct, share))
+        want.append(i not in bad)
+    p0 = backend.counters.pairing_checks
+    assert backend.verify_dec_shares(items) == want
+    assert backend.counters.pairing_checks - p0 <= 8  # two leaves at most

@@ -213,3 +213,64 @@ def test_combine_dec_shares_batch_lane_capped_chunks(backend, keyset, rng):
     got = backend.combine_dec_shares_batch(pks, items)
     assert got == msgs
     assert backend.counters.device_dispatches == d0 + 3
+
+
+def test_sign_shares_batch_device_path(backend, keyset):
+    """Batched G2 coin-share generation must match the host golden
+    sign_share bit-for-bit (and actually dispatch once)."""
+    sks, pks = keyset
+    items = []
+    for j in range(3):
+        doc = bytes([90 + j]) * 8
+        for i in (0, 1, 2):
+            items.append((sks.secret_key_share(i), doc))
+    d0 = backend.counters.device_dispatches
+    backend.device_combine_threshold = 2  # force the device path
+    got = backend.sign_shares_batch(items)
+    assert backend.counters.device_dispatches == d0 + 1
+    want = [sk.sign_share(doc) for sk, doc in items]
+    assert [g.el for g in got] == [w.el for w in want]
+    # shares verify against their public key shares
+    assert backend.verify_sig_shares(
+        [(pks.public_key_share(i % 3), items[i][1], got[i]) for i in range(9)]
+    ) == [True] * 9
+
+
+def test_combine_sig_shares_batch_device_path(backend, keyset):
+    """Batched G2 Lagrange combines over DIFFERENT share subsets must all
+    produce the unique master signature (and match the host golden)."""
+    sks, pks = keyset
+    doc = b"batch-combine-sig"
+    all_shares = {i: sks.secret_key_share(i).sign_share(doc) for i in range(4)}
+    want = pks.combine_signatures({i: all_shares[i] for i in (0, 1)})
+    items = [
+        ({0: all_shares[0], 1: all_shares[1]}, doc),
+        ({2: all_shares[2], 3: all_shares[3]}, None),
+        ({1: all_shares[1], 3: all_shares[3]}, doc),
+    ]
+    backend.device_combine_threshold = 2  # force the device path
+    got = backend.combine_sig_shares_batch(pks, items)
+    assert all(s == want for s in got), "subset-independence violated"
+    assert pks.public_key().verify(got[0], doc)
+
+
+def test_combine_sig_shares_batch_reverify_falls_back(
+    backend, keyset, monkeypatch
+):
+    """A corrupted device batch combine must be caught by the doc-carrying
+    re-verify and replaced by the host golden combine."""
+    sks, pks = keyset
+    doc = b"batch-reverify"
+    shares = {i: sks.secret_key_share(i).sign_share(doc) for i in range(2)}
+    want = pks.combine_signatures(shares)
+    wrong = backend.group.hash_to_g2(b"garbage point")
+    monkeypatch.setattr(
+        backend,
+        "_combine_sig_chunk",
+        lambda pk_set, items, idxs, k, out: out.__setitem__(
+            idxs[0], type(want)(backend.group, wrong)
+        ),
+    )
+    backend.device_combine_threshold = 2
+    got = backend.combine_sig_shares_batch(pks, [(shares, doc)])
+    assert got[0] == want  # fallback repaired it
