@@ -611,6 +611,37 @@ class TpuBackend(CryptoBackend):
         for idx, el in zip(idxs, els[: len(idxs)]):
             out[idx] = self._plaintext_from_combined(el, items[idx][1])
 
+    def _ladder_batch(self, scalars, points, host_fn, chunk_self, to_device,
+                      from_device, jitted):
+        """Shared body of the batched independent-ladder dispatches
+        (decrypt-share generation in G1, coin-share signing in G2):
+        threshold gate → lane-capped chunk recursion → bucket pad →
+        one device dispatch → unwrap.
+
+        ``host_fn(i)`` is the per-item host golden below the threshold;
+        ``chunk_self(sub_range)`` recurses on a lane-capped slice."""
+        n = len(scalars)
+        if n < self.device_combine_threshold:
+            return [host_fn(i) for i in range(n)]
+        if n > self.device_lane_cap:  # lane-capped chunks (HBM bound)
+            out = []
+            for lo in range(0, n, self.device_lane_cap):
+                out.extend(chunk_self(slice(lo, lo + self.device_lane_cap)))
+            return out
+        b = self._pad_bucket(n)
+        safe = [curve.safe_scalar(s) for s in scalars]
+        bits = curve.scalars_to_bits([s for s, _ in safe])
+        negs = np.array([neg for _, neg in safe])
+        pts = list(points)
+        if b > n:
+            bits = np.concatenate([bits, np.repeat(bits[:1], b - n, axis=0)])
+            negs = np.concatenate([negs, np.repeat(negs[:1], b - n)])
+            pts = pts + [pts[0]] * (b - n)
+        P = to_device(pts)
+        self.counters.device_dispatches += 1
+        out = jitted(*self._place((P, jnp.asarray(bits), jnp.asarray(negs))))
+        return from_device(out)[:n]
+
     def sign_shares_batch(
         self, items: Sequence[Tuple[Any, bytes]]
     ) -> List[SignatureShare]:
@@ -620,32 +651,19 @@ class TpuBackend(CryptoBackend):
 
         H2(doc) has order r by construction (hash_to_g2 clears the
         cofactor), satisfying the device ladder's precondition."""
-        n = len(items)
-        if n < self.device_combine_threshold:
-            return [sk.sign_share(doc) for sk, doc in items]
-        if n > self.device_lane_cap:  # lane-capped chunks (HBM bound)
-            out: List[SignatureShare] = []
-            for lo in range(0, n, self.device_lane_cap):
-                out.extend(
-                    self.sign_shares_batch(items[lo : lo + self.device_lane_cap])
-                )
-            return out
-        b = self._pad_bucket(n)
-        safe = [curve.safe_scalar(sk.x) for sk, _ in items]
-        bits = curve.scalars_to_bits([s for s, _ in safe])
-        negs = np.array([neg for _, neg in safe])
-        pts = [self._hash_g2(doc) for _, doc in items]
-        if b > n:
-            bits = np.concatenate([bits, np.repeat(bits[:1], b - n, axis=0)])
-            negs = np.concatenate([negs, np.repeat(negs[:1], b - n)])
-            pts = pts + [pts[0]] * (b - n)
-        P = curve.g2_to_device(pts)
-        self.counters.device_dispatches += 1
-        out = _jitted_g2_mul_batch()(
-            *self._place((P, jnp.asarray(bits), jnp.asarray(negs)))
+        els = self._ladder_batch(
+            [sk.x for sk, _ in items],
+            [self._hash_g2(doc) for _, doc in items],
+            lambda i: items[i][0].sign_share(items[i][1]),
+            lambda sub: self.sign_shares_batch(items[sub]),
+            curve.g2_to_device,
+            curve.g2_from_device,
+            _jitted_g2_mul_batch(),
         )
-        els = curve.g2_from_device(out)[:n]
-        return [SignatureShare(self.group, el) for el in els]
+        return [
+            el if isinstance(el, SignatureShare) else SignatureShare(self.group, el)
+            for el in els
+        ]
 
     def combine_sig_shares_batch(
         self,
@@ -760,29 +778,16 @@ class TpuBackend(CryptoBackend):
         points; this is guaranteed because encrypt() constructs u = rG1
         and network-deserialized points pass the subgroup check in
         bls381.g1_from_bytes (g1_in_subgroup)."""
-        n = len(items)
-        if n < self.device_combine_threshold:
-            return [sk.decrypt_share_unchecked(ct) for sk, ct in items]
-        if n > self.device_lane_cap:  # lane-capped chunks (HBM bound)
-            out: List[DecryptionShare] = []
-            for lo in range(0, n, self.device_lane_cap):
-                out.extend(
-                    self.decrypt_shares_batch(items[lo : lo + self.device_lane_cap])
-                )
-            return out
-        b = self._pad_bucket(n)
-        safe = [curve.safe_scalar(sk.x) for sk, _ in items]
-        bits = curve.scalars_to_bits([s for s, _ in safe])
-        negs = np.array([neg for _, neg in safe])
-        pts = [ct.u for _, ct in items]
-        if b > n:
-            bits = np.concatenate([bits, np.repeat(bits[:1], b - n, axis=0)])
-            negs = np.concatenate([negs, np.repeat(negs[:1], b - n)])
-            pts = pts + [pts[0]] * (b - n)
-        P = curve.g1_to_device(pts)
-        self.counters.device_dispatches += 1
-        out = _jitted_g1_mul_batch()(
-            *self._place((P, jnp.asarray(bits), jnp.asarray(negs)))
+        els = self._ladder_batch(
+            [sk.x for sk, _ in items],
+            [ct.u for _, ct in items],
+            lambda i: items[i][0].decrypt_share_unchecked(items[i][1]),
+            lambda sub: self.decrypt_shares_batch(items[sub]),
+            curve.g1_to_device,
+            curve.g1_from_device,
+            _jitted_g1_mul_batch(),
         )
-        els = curve.g1_from_device(out)[:n]
-        return [DecryptionShare(self.group, el) for el in els]
+        return [
+            el if isinstance(el, DecryptionShare) else DecryptionShare(self.group, el)
+            for el in els
+        ]

@@ -63,3 +63,39 @@ def test_sharded_decrypt_roundtrip(backend, keyset, rng):
     finally:
         backend.device_combine_threshold = 8
     assert out == [msg] * 3
+
+
+def test_lane_capped_chunks_across_mesh(backend, keyset, rng):
+    """Chunking × sharding (round-2 verdict Weak #7): a combine batch
+    above device_lane_cap must split into lane-capped chunks, each chunk
+    itself sharded across the 8-device mesh (bucket widened to a mesh
+    multiple), with results correct and in order — the soak-shape (N=256
+    scale item count) interaction the seam previously never exercised."""
+    sks, pks = keyset
+    n_items = 256  # soak-scale combine count (N=256 network, dedup'd)
+    cts = []
+    msgs = []
+    items = []
+    for j in range(n_items):
+        msg = bytes([j % 251]) * 8
+        ct = pks.encrypt(msg, rng)
+        shares = {
+            i: sks.secret_key_share(i).decrypt_share_unchecked(ct)
+            for i in (0, 2)
+        }
+        items.append((shares, ct))
+        cts.append(ct)
+        msgs.append(msg)
+    d0 = backend.counters.device_dispatches
+    backend.device_combine_threshold = 2
+    saved_cap = backend.device_lane_cap
+    backend.device_lane_cap = 128  # k=2 → 64 items/chunk → 4 chunks
+    try:
+        got = backend.combine_dec_shares_batch(pks, items)
+    finally:
+        backend.device_combine_threshold = 8
+        backend.device_lane_cap = saved_cap
+    assert got == msgs
+    assert backend.counters.device_dispatches == d0 + 4
+    # each chunk's 64-item bucket is a mesh multiple, so it sharded evenly
+    assert backend._pad_bucket(64) % 8 == 0
