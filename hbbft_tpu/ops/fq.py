@@ -380,3 +380,44 @@ def batch_inv(x: jnp.ndarray) -> jnp.ndarray:
 def is_zero_host(limbs) -> bool:
     """Host-side exact zero test (the only canonical compare we ever need)."""
     return to_int(limbs) == 0
+
+
+# -- implementation facade ----------------------------------------------------
+#
+# HBBFT_TPU_FQ_IMPL=rns swaps the whole public surface for the RNS /
+# MXU-matmul implementation (ops/fq_rns.py): same API, same semantics
+# (values mod Q through from_int/to_int), different device layout —
+# (..., 79) residue lanes instead of (..., 50) limbs.  Everything above
+# the Fq API (tower, curve, pairing, backend) is representation-agnostic
+# and picks the binding up at import.  The limb internals (reduce_conv,
+# BITS/CONV/_FOLD_ROWS, the Pallas kernels) stay limb-only: under RNS the
+# rebound `mul` never routes through them.
+_FQ_IMPL = os.environ.get("HBBFT_TPU_FQ_IMPL", "limb")
+if _FQ_IMPL == "rns":
+    from hbbft_tpu.ops import fq_rns as _rns
+
+    NLIMBS = _rns.NLIMBS
+    DTYPE = _rns.DTYPE
+    NP_DTYPE = _rns.NP_DTYPE
+    ZERO = _rns.ZERO
+    ONE = _rns.ONE
+    from_int = _rns.from_int
+    from_ints = _rns.from_ints
+    to_int = _rns.to_int
+    to_ints = _rns.to_ints
+    carry3 = _rns.carry3
+    add = _rns.add
+    sub = _rns.sub
+    neg = _rns.neg
+    mul = _rns.mul
+    sqr = _rns.sqr
+    mul_n = _rns.mul_n
+    mul_small = _rns.mul_small
+    reduce_small = _rns.reduce_small
+    select = _rns.select
+    pow_fixed = _rns.pow_fixed
+    inv = _rns.inv
+    batch_inv = _rns.batch_inv
+    is_zero_host = _rns.is_zero_host
+elif _FQ_IMPL != "limb":  # pragma: no cover - configuration error
+    raise ValueError(f"HBBFT_TPU_FQ_IMPL must be 'limb' or 'rns', got {_FQ_IMPL}")

@@ -1,0 +1,157 @@
+"""Goldens for the RNS/MXU Fq implementation (ops/fq_rns.py).
+
+Direct-import tests cover the representation itself against Python-int
+arithmetic; one subprocess test locks the HBBFT_TPU_FQ_IMPL=rns facade
+end-to-end through the tower (the full curve/pairing suites are run
+under the flag manually / in perf passes — they share the same seam).
+"""
+
+import os
+import random
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from hbbft_tpu.crypto.field import Q
+from hbbft_tpu.ops import fq_rns as R
+
+
+@pytest.fixture(scope="module")
+def rng():
+    return random.Random(42)
+
+
+def _dev(x: int):
+    return jnp.asarray(R.from_int(x))
+
+
+def test_roundtrip_and_montgomery_form(rng):
+    for _ in range(8):
+        x = rng.randrange(Q)
+        assert R.to_int(R.from_int(x)) == x
+    assert R.to_int(np.asarray(R.ZERO)) == 0
+    assert R.to_int(R.ONE) == 1
+
+
+def test_mul_matches_python(rng):
+    for _ in range(16):
+        a, b = rng.randrange(Q), rng.randrange(Q)
+        assert R.to_int(np.asarray(R.mul(_dev(a), _dev(b)))) == a * b % Q
+
+
+def test_mul_batched_shapes(rng):
+    xs = [rng.randrange(Q) for _ in range(6)]
+    ys = [rng.randrange(Q) for _ in range(6)]
+    A = jnp.asarray(R.from_ints(xs))
+    B = jnp.asarray(R.from_ints(ys))
+    got = R.to_ints(np.asarray(R.mul(A, B)))
+    assert got == [x * y % Q for x, y in zip(xs, ys)]
+
+
+def test_lazy_chain_with_negatives(rng):
+    """Adds/subs drift residues out of range and the VALUE negative; mul
+    renormalizes both (the sign-offset + S-K exactness claim)."""
+    for _ in range(6):
+        vals = [rng.randrange(Q) for _ in range(12)]
+        acc = _dev(vals[0])
+        accv = vals[0]
+        for v in vals[1:6]:
+            acc = R.add(acc, _dev(v))
+            accv += v
+        for v in vals[6:]:
+            acc = R.sub(acc, _dev(v))
+            accv -= v  # accv frequently negative here
+        got = R.to_int(np.asarray(R.mul(acc, _dev(vals[0]))))
+        assert got == accv * vals[0] % Q
+
+
+def test_deep_linear_chain_via_reduce_small(rng):
+    """The cyclo-sqr growth pattern: value doubles per step; reduce_small
+    must renormalize so 64 chained steps stay exact."""
+    x = rng.randrange(Q)
+    acc = _dev(x)
+    accv = x
+    for _ in range(64):
+        acc = R.reduce_small(R.add(acc, acc))
+        accv = 2 * accv % Q
+    assert R.to_int(np.asarray(acc)) == accv
+
+
+def test_mul_small_both_routes(rng):
+    a = rng.randrange(Q)
+    for k in (0, 1, 2, 3, 12, 64, -64, 65, -65, 4097, 32767, -32767):
+        got = R.to_int(np.asarray(R.mul_small(_dev(a), k)))
+        assert got == a * k % Q, k
+    with pytest.raises(ValueError):
+        R.mul_small(_dev(a), 1 << 15)
+
+
+def test_pow_inv_batch_inv(rng):
+    a = rng.randrange(1, Q)
+    assert R.to_int(np.asarray(R.pow_fixed(_dev(a), 5))) == pow(a, 5, Q)
+    assert R.to_int(np.asarray(R.inv(_dev(a)))) == pow(a, -1, Q)
+    xs = [rng.randrange(1, Q) for _ in range(4)]
+    got = R.to_ints(np.asarray(R.batch_inv(jnp.asarray(R.from_ints(xs)))))
+    assert got == [pow(x, -1, Q) for x in xs]
+
+
+def test_select_and_zero(rng):
+    a, b = _dev(rng.randrange(Q)), _dev(rng.randrange(Q))
+    cond = jnp.asarray(True)
+    assert R.to_int(np.asarray(R.select(cond, a, b))) == R.to_int(np.asarray(a))
+    assert R.is_zero_host(np.asarray(R.ZERO))
+    assert not R.is_zero_host(np.asarray(R.ONE))
+
+
+def test_exactness_margins():
+    """Every f32 intermediate bound the module relies on, re-derived."""
+    # extension partial sums: 39 terms of (p-1)*63 / (p-1)*31
+    pmax = max(R.B1 + R.B2)
+    assert R.N_B * (pmax - 1) * 63 < 1 << 24
+    # pointwise products of reduced lanes
+    assert (pmax - 1) ** 2 < 1 << 24
+    # closure: M1 over the offset bound
+    assert R.M1 > (Q << 34)
+    assert R._X_OFFSET_INT % Q == 0
+    # S-K digit fits the redundant modulus
+    assert R.M_R > R.N_B + 2
+
+
+def test_facade_subprocess_tower_pairing():
+    """HBBFT_TPU_FQ_IMPL=rns swaps the facade: the tower stack must stay
+    golden end-to-end (one fq12 mul + a cyclo chain under the flag)."""
+    code = """
+import jax; jax.config.update("jax_platforms", "cpu")
+import random
+from hbbft_tpu.ops import fq, tower
+from hbbft_tpu.crypto import bls381 as gold
+assert fq.NLIMBS == 79, fq.NLIMBS  # facade engaged
+rng = random.Random(3)
+def rnd_fq12():
+    return tuple(
+        tuple(tuple(rng.randrange(gold.Q) for _ in range(2)) for _ in range(3))
+        for _ in range(2)
+    )
+a, b = rnd_fq12(), rnd_fq12()
+dev = tower.fq12_mul(tower.fq12_stack([a]), tower.fq12_stack([b]))
+assert tower.fq12_to_ints(dev, 0) == gold.fq12_mul(a, b)
+print("FACADE_OK")
+"""
+    env = dict(os.environ)
+    env["HBBFT_TPU_FQ_IMPL"] = "rns"
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True,
+        text=True,
+        timeout=420,
+        env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    assert "FACADE_OK" in proc.stdout, proc.stdout + proc.stderr

@@ -29,7 +29,10 @@ import jax.numpy as jnp
 
 from hbbft_tpu.ops import fq
 
-CHAIN = 400  # data-dependent muls per timed dispatch
+CHAIN = int(os.environ.get("KB_CHAIN", "400"))  # muls per timed dispatch
+LANES = [
+    int(x) for x in os.environ.get("KB_LANES", "4096,16384,65536,262144").split(",")
+]
 
 
 @functools.partial(jax.jit, static_argnums=2)
@@ -66,12 +69,22 @@ def measure_mul(rng, lanes, reps=2):
 
 def main():
     rng = np.random.default_rng(0)
-    print(
-        f"backend={jax.default_backend()} BITS={fq.BITS} "
-        f"conv_mode={os.environ.get('HBBFT_TPU_CONV_MODE', 'scratch')} "
-        f"no_pallas={bool(os.environ.get('HBBFT_TPU_NO_PALLAS'))}"
+    impl = os.environ.get("HBBFT_TPU_FQ_IMPL", "limb")
+    limb_only = (
+        f" BITS={fq.BITS}"
+        f" conv_mode={os.environ.get('HBBFT_TPU_CONV_MODE', 'scratch')}"
+        f" no_pallas={bool(os.environ.get('HBBFT_TPU_NO_PALLAS'))}"
+        if impl == "limb"
+        else ""
     )
-    for lanes in (4096, 16384, 65536, 262144):
+    print(
+        f"backend={jax.default_backend()} impl={impl} "
+        f"width={fq.NLIMBS}{limb_only}"
+    )
+    # Under impl=rns the random inputs are valid residue VECTORS (every
+    # lane in range); the represented values are arbitrary, which is fine
+    # for throughput — the pipeline is branch-free and data-independent.
+    for lanes in LANES:
         dt = measure_mul(rng, lanes)
         print(
             f"lanes={lanes:7d}  fq.mul: {dt*1e3:8.4f} ms  "
