@@ -509,6 +509,69 @@ def bench_g2_sign() -> dict:
     }
 
 
+def bench_fq_kernel() -> dict:
+    """Raw Fq-multiply throughput, limb vs RNS, via tools/kernel_bench.py
+    in SUBPROCESSES (HBBFT_TPU_FQ_IMPL is read at import, so an in-process
+    A/B is impossible).  The value is the RNS rate — the round-3 MXU
+    reformulation this row exists to track; the limb rate rides along for
+    the A/B.  vs_baseline is against the round-2 on-chip limb asymptote
+    (217M muls/s)."""
+    import re
+    import subprocess
+
+    lanes = os.environ.get("BENCH_FQ_LANES", "65536")
+    chain = os.environ.get("BENCH_FQ_CHAIN", "200")
+
+    import jax
+
+    parent_backend = jax.default_backend()
+
+    def run(impl: str) -> float:
+        env = dict(os.environ)
+        env["HBBFT_TPU_FQ_IMPL"] = impl
+        env["KB_LANES"] = lanes
+        env["KB_CHAIN"] = chain
+        env["KB_NO_ROOFLINE"] = "1"  # probe is step-independent, full-size
+        proc = subprocess.run(
+            [sys.executable, os.path.join("tools", "kernel_bench.py")],
+            capture_output=True,
+            text=True,
+            timeout=1500,
+            env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        m = re.findall(r"([0-9.]+) M muls/s", proc.stdout)
+        if not m:
+            raise RuntimeError(
+                f"kernel_bench[{impl}] produced no rate:\n"
+                f"{proc.stdout[-800:]}\n{proc.stderr[-800:]}"
+            )
+        child = re.search(r"backend=(\S+)", proc.stdout)
+        child_backend = child.group(1) if child else "unknown"
+        if child_backend != parent_backend:
+            # a silent CPU fallback in the child must not be recorded
+            # under the parent's platform stamp (poisoned TPU artifact)
+            raise RuntimeError(
+                f"kernel_bench[{impl}] ran on {child_backend!r}, parent "
+                f"is {parent_backend!r} — refusing to record"
+            )
+        return float(m[-1])
+
+    rns = run("rns")
+    limb = run("limb")
+    return {
+        "metric": "fq_mul_throughput",
+        "value": round(rns * 1e6, 0),
+        "unit": "muls/s",
+        "vs_baseline": round(rns * 1e6 / 217e6, 3),
+        "baseline": "round-2 on-chip limb asymptote",
+        "impl": "rns",
+        "limb_muls_per_sec": round(limb * 1e6, 0),
+        "rns_vs_limb": round(rns / limb, 2) if limb else None,
+        "lanes": lanes,
+    }
+
+
 def bench_rs_encode() -> dict:
     """GF(2⁸) RS parity at the N=100 broadcast shape (34 data, 66 parity)."""
     import jax
@@ -947,6 +1010,8 @@ def main() -> None:
         ("rlc_dec_adversarial", bench_rlc_dec_adversarial),
         ("rs_encode", bench_rs_encode),
     ]
+    if os.environ.get("BENCH_FQ", "1") != "0":
+        extra.append(("fq_kernel", bench_fq_kernel))
     if os.environ.get("BENCH_N100", "1") != "0":
         extra.append(("n100", bench_epochs_n100))
     if os.environ.get("BENCH_ARRAY", "1") != "0":
@@ -1003,6 +1068,8 @@ def main() -> None:
             ("BENCH_SOAK_EPOCHS", "1"),
             ("BENCH_COIN_MACRO_EPOCHS", "1"),
             ("BENCH_ARRAY_CHURN", "0"),
+            ("BENCH_FQ_LANES", "4096"),
+            ("BENCH_FQ_CHAIN", "50"),
         ):
             os.environ.setdefault(var, val)
     for name, fn in [("rlc_dec", bench_rlc_dec)] + extra:

@@ -91,6 +91,9 @@ def main():
             f"{lanes/dt/1e6:8.2f} M muls/s"
         )
 
+    if os.environ.get("KB_NO_ROOFLINE"):
+        return  # bench.py's subprocess A/B skips the fixed-size probe
+
     # VPU roofline probe: same chain+fence discipline, pure FMA body.
     lanes = 262144
     rows = 50

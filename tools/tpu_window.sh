@@ -18,7 +18,9 @@ cd "$(dirname "$0")/.."
 TS() { date -u +%H:%M:%S; }
 
 echo "=== $(TS) step 1: full driver bench (tpu) ==="
-timeout 3600 python bench.py
+# BENCH_FQ=0: step 2 runs the kernel A/B dedicated; keep step 1's budget
+# for the macro rows it exists to capture.
+BENCH_FQ=0 timeout 3600 python bench.py
 
 echo "=== $(TS) step 2: kernel A/B limb vs rns ==="
 timeout 1200 python tools/kernel_bench.py
