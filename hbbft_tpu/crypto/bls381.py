@@ -482,10 +482,48 @@ def g2_in_subgroup(p) -> bool:
         return True
     if _PSI_CONSTS is None:  # pragma: no cover - ψ resolves for BLS12-381
         return ec_mul(FQ2, R, p) is None
+    return _psi(p) == ec_mul(FQ2, _G2_EIGEN, p)
+
+
+def _psi(p):
+    """The twist endomorphism (requires _PSI_CONSTS; p not None)."""
     cx, cy = _PSI_CONSTS
     x, y = p
-    psi = (fq2_mul(cx, fq2_conj(x)), fq2_mul(cy, fq2_conj(y)))
-    return psi == ec_mul(FQ2, _G2_EIGEN, p)
+    return (fq2_mul(cx, fq2_conj(x)), fq2_mul(cy, fq2_conj(y)))
+
+
+def clear_cofactor_g1(p):
+    """Map an on-curve G1 point into the r-order subgroup.
+
+    Fast path: [1−u]·P (the standard BLS12 effective cofactor — a 64-bit
+    ladder instead of the 126-bit h1 multiplication).  Falls back to the
+    full-cofactor multiply if the φ self-validation ever failed."""
+    if _BETA is None:  # pragma: no cover - β resolves for BLS12-381
+        return ec_mul(FQ, G1_COFACTOR, p)
+    u = -BLS_X if BLS_X_IS_NEG else BLS_X
+    return ec_mul(FQ, 1 - u, p)
+
+
+def clear_cofactor_g2(p):
+    """Map an on-curve twist point into the r-order G2 subgroup.
+
+    Budroni–Pintore fast clearing: [u²−u−1]·P + [u−1]·ψ(P) + ψ²(2P) —
+    three 64-bit ladders plus endomorphism applications, ~3× cheaper than
+    the 508-bit effective-cofactor ladder.  This DEFINES the hash-to-G2
+    output (it differs from the naive h2 multiple by a fixed scalar),
+    which is fine: the framework is its own hash-to-curve universe and
+    nothing persists hash outputs across versions."""
+    if p is None:
+        return None
+    if _PSI_CONSTS is None:  # pragma: no cover - ψ resolves for BLS12-381
+        return ec_mul(FQ2, G2_COFACTOR, p)
+    u = -BLS_X if BLS_X_IS_NEG else BLS_X
+    uP = ec_mul(FQ2, u, p)
+    u1P = ec_add(FQ2, uP, ec_neg(FQ2, p))  # [u−1]P
+    t = ec_add(FQ2, ec_mul(FQ2, u, u1P), ec_neg(FQ2, p))  # [u²−u−1]P
+    psiP = _psi(p)
+    t = ec_add(FQ2, t, ec_add(FQ2, ec_mul(FQ2, u, psiP), ec_neg(FQ2, psiP)))
+    return ec_add(FQ2, t, _psi(_psi(ec_double(FQ2, p))))
 
 
 # ---------------------------------------------------------------------------
@@ -584,7 +622,7 @@ def hash_to_g1(data: bytes):
         if y is not None:
             # Deterministic sign choice: take the "smaller" root.
             y = min(y, Q - y)
-            p = ec_mul(FQ, G1_COFACTOR, (x, y))
+            p = clear_cofactor_g1((x, y))
             if p is not None:
                 return p
         ctr += 1
@@ -603,7 +641,7 @@ def hash_to_g2(data: bytes):
         if y is not None:
             neg = fq2_neg(y)
             y = min(y, neg)  # lexicographic tuple order: deterministic sign
-            p = ec_mul(FQ2, G2_COFACTOR, (x, y))
+            p = clear_cofactor_g2((x, y))
             if p is not None:
                 return p
         ctr += 1

@@ -100,18 +100,20 @@ P_VEC = _P_ALL.astype(NP_DTYPE)
 _INV_P = (1.0 / _P_ALL).astype(NP_DTYPE)
 
 # Montgomery per-lane constants.
-_NEG_QINV_B1 = np.array(
-    [(-pow(Q, -1, p)) % p for p in B1], dtype=NP_DTYPE
-)  # −Q⁻¹ mod p_i,  i ∈ B1
-_Q_B2R = np.array(
-    [Q % p for p in B2] + [Q % M_R], dtype=NP_DTYPE
-)  # Q mod p_j,  j ∈ B2∪{m_r}
 _M1INV_B2R = np.array(
     [pow(M1, -1, p) for p in B2] + [pow(M1, -1, M_R)], dtype=NP_DTYPE
-)  # M1⁻¹ mod p_j
-_W1INV_B1 = np.array(
-    [pow(M1 // p, -1, p) for p in B1], dtype=NP_DTYPE
-)  # (M1/p_i)⁻¹ mod p_i
+)  # M1⁻¹ mod p_j,  j ∈ B2∪{m_r}
+# fused: σ_i = x_i·(−Q⁻¹·(M1/p_i)⁻¹ mod p_i) — one product+mod, not two
+_SIGMA_C_B1 = np.array(
+    [((-pow(Q, -1, p)) % p) * pow(M1 // p, -1, p) % p for p in B1],
+    dtype=NP_DTYPE,
+)
+# fused: r_j = x_j·M1⁻¹ + q̂_j·(Q·M1⁻¹ mod p_j) — both products < 2^22,
+# sum < 2^23, ONE reduction instead of three
+_QM1INV_B2R = np.array(
+    [Q * pow(M1, -1, p) % p for p in B2] + [Q * pow(M1, -1, M_R) % M_R],
+    dtype=NP_DTYPE,
+)
 _W2INV_B2 = np.array(
     [pow(M2 // p, -1, p) for p in B2], dtype=NP_DTYPE
 )  # (M2/p_j)⁻¹ mod p_j
@@ -298,9 +300,8 @@ _INVP_B1R = jnp.asarray(np.concatenate([_INV_P[_S1], _INV_P[_SR]]))
 _X_OFF_J = jnp.asarray(
     np.array([_X_OFFSET_INT % int(p) for p in _P_ALL], dtype=NP_DTYPE)
 )
-_NEG_QINV_B1_J = jnp.asarray(_NEG_QINV_B1)
-_W1INV_B1_J = jnp.asarray(_W1INV_B1)
-_Q_B2R_J = jnp.asarray(_Q_B2R)
+_SIGMA_C_B1_J = jnp.asarray(_SIGMA_C_B1)
+_QM1INV_B2R_J = jnp.asarray(_QM1INV_B2R)
 _M1INV_B2R_J = jnp.asarray(_M1INV_B2R)
 _W2INV_B2_J = jnp.asarray(_W2INV_B2)
 _M2_B1_J = jnp.asarray(_M2_B1)
@@ -319,23 +320,20 @@ def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     # sign offset (multiple of Q): the reduced integer is non-negative
     x = _mod_lanes(x + _X_OFF_J, _P_J, _INVP_J)
 
-    # q = −x·Q⁻¹ mod M1, lane-wise over B1; σ = q_i·(M1/p_i)⁻¹ mod p_i.
+    # σ_i = (−x·Q⁻¹ mod M1)·(M1/p_i)⁻¹ mod p_i, constants fused.
     p1, ip1 = _P_J[_S1], _INVP_J[_S1]
-    q1 = _mod_lanes(x[..., _S1] * _NEG_QINV_B1_J, p1, ip1)
-    sigma = _mod_lanes(q1 * _W1INV_B1_J, p1, ip1)
+    sigma = _mod_lanes(x[..., _S1] * _SIGMA_C_B1_J, p1, ip1)
 
     # Extension 1 (uncorrected CRT sum): q̂ = q + δ·M1, δ ≤ 38 — the
     # slack lands in the lazy value bound, not in correctness.
     qhat = _ext_matmul(sigma, _E1_LO_J, _E1_HI_J, _P_B2R, _INVP_B2R)
 
-    # r = (x + q̂·Q) / M1 over B2 ∪ {m_r}.
+    # r = (x + q̂·Q)/M1 over B2 ∪ {m_r}: expanded as x·M1⁻¹ + q̂·(Q·M1⁻¹)
+    # — both products < 2^22, so ONE reduction covers the sum.
     x2r = jnp.concatenate([x[..., _S2], x[..., _SR]], axis=-1)
-    t = _mod_lanes(
-        x2r + _mod_lanes(qhat * _Q_B2R_J, _P_B2R, _INVP_B2R),
-        _P_B2R,
-        _INVP_B2R,
+    r2r = _mod_lanes(
+        x2r * _M1INV_B2R_J + qhat * _QM1INV_B2R_J, _P_B2R, _INVP_B2R
     )
-    r2r = _mod_lanes(t * _M1INV_B2R_J, _P_B2R, _INVP_B2R)
     r2 = r2r[..., :N_B]
     r_mr = r2r[..., N_B:]
 

