@@ -308,13 +308,27 @@ def run_array(args, backend, rng: random.Random) -> List[dict]:
     from hbbft_tpu.engine import ArrayHoneyBadgerNet
 
     net = ArrayHoneyBadgerNet(
-        range(args.num_nodes), backend=backend, seed=args.seed
+        range(args.num_nodes),
+        backend=backend,
+        seed=args.seed,
+        coin_rounds=getattr(args, "coin_rounds", 0),
+        dynamic=bool(getattr(args, "churn_at", None)),
     )
+    churn_at = set(getattr(args, "churn_at", None) or [])
+    bad = [e for e in churn_at if not 0 <= e < args.epochs]
+    if bad:
+        raise SystemExit(f"--churn-at indices out of range: {bad}")
     rows: List[dict] = []
     vtime = 0.0
     wall0 = time.perf_counter()
     delivered = 0
     for epoch in range(args.epochs):
+        if epoch in churn_at:
+            crep = net.era_change()
+            print(
+                f"  era change before epoch {epoch}: era={net.era} "
+                f"votes={crep.votes_verified} kg_acks={crep.kg_acks_handled}"
+            )
         contribs = {}
         for nid in net.ids:
             txs = [
@@ -379,6 +393,16 @@ def main(argv: Optional[List[str]] = None) -> int:
         "whole-network engine (hbbft_tpu/engine)",
     )
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument(
+        "--coin-rounds", type=int, default=0, dest="coin_rounds",
+        help="array engine: real threshold-sign coin rounds per BA "
+        "instance (the split-input schedule; 0 = fixed-coin fast path)",
+    )
+    p.add_argument(
+        "--churn-at", type=int, nargs="*", dest="churn_at", default=None,
+        help="array engine: epoch indices before which a vote->DKG->era "
+        "change runs (BASELINE config 3 churn)",
+    )
     p.add_argument(
         "--checkpoint",
         metavar="FILE",

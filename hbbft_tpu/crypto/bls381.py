@@ -473,7 +473,10 @@ def _resolve_psi():
 
 
 _PSI_CONSTS = _resolve_psi()
-_G2_EIGEN = -BLS_X if BLS_X_IS_NEG else BLS_X
+#: the signed BLS parameter u — the single source for every site that
+#: needs it (ψ eigenvalue, both cofactor clearings)
+_U = -BLS_X if BLS_X_IS_NEG else BLS_X
+_G2_EIGEN = _U
 
 
 def g2_in_subgroup(p) -> bool:
@@ -500,8 +503,7 @@ def clear_cofactor_g1(p):
     full-cofactor multiply if the φ self-validation ever failed."""
     if _BETA is None:  # pragma: no cover - β resolves for BLS12-381
         return ec_mul(FQ, G1_COFACTOR, p)
-    u = -BLS_X if BLS_X_IS_NEG else BLS_X
-    return ec_mul(FQ, 1 - u, p)
+    return ec_mul(FQ, 1 - _U, p)
 
 
 def clear_cofactor_g2(p):
@@ -517,12 +519,11 @@ def clear_cofactor_g2(p):
         return None
     if _PSI_CONSTS is None:  # pragma: no cover - ψ resolves for BLS12-381
         return ec_mul(FQ2, G2_COFACTOR, p)
-    u = -BLS_X if BLS_X_IS_NEG else BLS_X
-    uP = ec_mul(FQ2, u, p)
+    uP = ec_mul(FQ2, _U, p)
     u1P = ec_add(FQ2, uP, ec_neg(FQ2, p))  # [u−1]P
-    t = ec_add(FQ2, ec_mul(FQ2, u, u1P), ec_neg(FQ2, p))  # [u²−u−1]P
+    t = ec_add(FQ2, ec_mul(FQ2, _U, u1P), ec_neg(FQ2, p))  # [u²−u−1]P
     psiP = _psi(p)
-    t = ec_add(FQ2, t, ec_add(FQ2, ec_mul(FQ2, u, psiP), ec_neg(FQ2, psiP)))
+    t = ec_add(FQ2, t, ec_add(FQ2, ec_mul(FQ2, _U, psiP), ec_neg(FQ2, psiP)))
     return ec_add(FQ2, t, _psi(_psi(ec_double(FQ2, p))))
 
 
