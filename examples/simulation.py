@@ -307,17 +307,17 @@ def run_array(args, backend, rng: random.Random) -> List[dict]:
     lockstep model, so handling cost is per-round, not per-message)."""
     from hbbft_tpu.engine import ArrayHoneyBadgerNet
 
+    churn_at = set(getattr(args, "churn_at", None) or [])
+    bad = [e for e in churn_at if not 0 <= e < args.epochs]
+    if bad:  # validate BEFORE paying N-node key generation
+        raise SystemExit(f"--churn-at indices out of range: {bad}")
     net = ArrayHoneyBadgerNet(
         range(args.num_nodes),
         backend=backend,
         seed=args.seed,
         coin_rounds=getattr(args, "coin_rounds", 0),
-        dynamic=bool(getattr(args, "churn_at", None)),
+        dynamic=bool(churn_at),
     )
-    churn_at = set(getattr(args, "churn_at", None) or [])
-    bad = [e for e in churn_at if not 0 <= e < args.epochs]
-    if bad:
-        raise SystemExit(f"--churn-at indices out of range: {bad}")
     rows: List[dict] = []
     vtime = 0.0
     wall0 = time.perf_counter()
@@ -325,9 +325,17 @@ def run_array(args, backend, rng: random.Random) -> List[dict]:
     for epoch in range(args.epochs):
         if epoch in churn_at:
             crep = net.era_change()
+            # fold the churn's network/rounds cost into the SAME virtual
+            # clock the epochs use (its crypto already lands in the
+            # cumulative counter columns)
+            vtime += crep.rounds * (
+                args.lam / 1000.0 + args.cpu_factor / 1000.0
+            )
+            delivered += crep.messages_delivered
             print(
                 f"  era change before epoch {epoch}: era={net.era} "
-                f"votes={crep.votes_verified} kg_acks={crep.kg_acks_handled}"
+                f"votes={crep.votes_verified} kg_acks={crep.kg_acks_handled} "
+                f"msgs={crep.messages_delivered}"
             )
         contribs = {}
         for nid in net.ids:
@@ -431,6 +439,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             p.error("--checkpoint/--resume require the object engine")
         rows = run_array(args, backend, rng)
     else:
+        if args.churn_at is not None or args.coin_rounds:
+            p.error("--churn-at/--coin-rounds require --engine array")
         if args.resume:
             with open(args.resume, "rb") as fh:
                 sim = Simulation.from_checkpoint(args, backend, fh.read())

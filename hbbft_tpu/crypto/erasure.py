@@ -185,13 +185,15 @@ class RSCodec:
             raise ValueError(f"need {self.k} shards, have {len(present)}")
         use = present[: self.k]
         xs = [i for i, _ in use]
-        shard_len = len(use[0][1])
-        stack = np.stack(
-            [np.frombuffer(s, dtype=np.uint8) for _, s in use], axis=0
-        )
         missing = [i for i, s in enumerate(shards) if s is None]
         out = list(shards)
         if missing:
+            # stack construction only when there is interpolation to do —
+            # the all-present case (every lockstep RBC at quiescence) has
+            # no RS math at all
+            stack = np.stack(
+                [np.frombuffer(s, dtype=np.uint8) for _, s in use], axis=0
+            )
             rec = self._interpolate(xs, missing, stack)
             for row, idx in enumerate(missing):
                 out[idx] = rec[row].tobytes()

@@ -146,6 +146,12 @@ class ArrayHoneyBadgerNet:
         self.pk_set = any_info.public_key_set
         self.pk_master = self.pk_set.public_key()
         self.threshold = self.pk_set.threshold()
+        # polynomial-commitment evaluations are per-era constants; the
+        # round-8 loop would otherwise re-evaluate them N² times per epoch
+        # (0.8 s/epoch at N=100, measured)
+        self.pk_shares = [
+            self.pk_set.public_key_share(i) for i in range(self.n)
+        ]
         self.codec = rs_codec(self.n - 2 * self.f, 2 * self.f)
 
     # -- helpers -------------------------------------------------------------
@@ -323,7 +329,7 @@ class ArrayHoneyBadgerNet:
         items = []
         for p in self.ids:
             for s_idx in range(n):
-                pk_share = self.pk_set.public_key_share(s_idx)
+                pk_share = self.pk_shares[s_idx]
                 item = (pk_share, cts[p], dec_shares[p][s_idx])
                 reps = 1 if self.dedup_verifies else n - 1
                 items.extend([item] * reps)
@@ -419,7 +425,7 @@ class ArrayHoneyBadgerNet:
         for p_idx in range(n):
             for s_idx in range(n):
                 item = (
-                    self.pk_set.public_key_share(s_idx),
+                    self.pk_shares[s_idx],
                     docs[p_idx],
                     shares[p_idx][s_idx],
                 )
@@ -548,6 +554,7 @@ class ArrayHoneyBadgerNet:
         self.pk_set = first
         self.pk_master = first.public_key()
         self.threshold = first.threshold()
+        self.pk_shares = [first.public_key_share(i) for i in range(n)]
         self.era += 1
         self.churn_reports.append(rep)
         return rep
