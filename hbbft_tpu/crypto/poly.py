@@ -175,6 +175,21 @@ class BivarCommitment:
             acc = g.g1_add(g.g1_mul(x, acc), inner)
         return acc
 
+    def col(self, y: int) -> Commitment:
+        """Commitment to f(·, y) — the ACKER-variable polynomial with the
+        receiver coordinate fixed.  Pre-computing this once per (part,
+        receiver) turns every ack cross-check from a full (t+1)² bivariate
+        evaluation into a (t+1)-term univariate one (the N=100 era change
+        was >600 s before; SURVEY.md §3.4)."""
+        g = self.G
+        out = []
+        for i in range(len(self.coeffs)):
+            acc = g.g1_identity()
+            for j in reversed(range(len(self.coeffs))):
+                acc = g.g1_add(g.g1_mul(y, acc), self.coeffs[i][j])
+            out.append(acc)
+        return Commitment(g, out)
+
     def row(self, x: int) -> Commitment:
         """Commitment to f(x, ·)."""
         g = self.G

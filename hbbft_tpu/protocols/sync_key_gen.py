@@ -93,6 +93,11 @@ class AckOutcome:
 
 
 class _ProposalState:
+    #: lazy Commitment to f(·, our_idx+1); CLASS-level default so snapshots
+    #: taken before this cache existed restore cleanly (snapshot.py rebuilds
+    #: via __new__ + setattr of saved attributes only)
+    our_col = None
+
     def __init__(self, commit: BivarCommitment) -> None:
         self.commit = commit
         self.acks: set = set()  # acker indices
@@ -251,7 +256,12 @@ class SyncKeyGen:
                 return AckOutcome(fault="sync_key_gen:invalid_ack_encryption")
             # Cross-check against the commitment:
             # f_p(acker+1, our+1) · G1 == commit(acker+1, our+1).
-            expect = state.commit.evaluate(acker_idx + 1, our_idx + 1)
+            # The receiver coordinate is fixed for every ack of this part,
+            # so the column commitment is computed once and each ack costs
+            # one univariate evaluation (t+1 ops, not (t+1)²).
+            if state.our_col is None:
+                state.our_col = state.commit.col(our_idx + 1)
+            expect = state.our_col.evaluate(acker_idx + 1)
             if self.G.g1_mul(v, self.G.g1()) != expect:
                 return AckOutcome(fault="sync_key_gen:ack_value_mismatch")
             state.values[acker_idx] = v

@@ -166,3 +166,23 @@ def test_dkg_on_real_curve():
         assert pk_set.public_key_share(i).verify_sig_share(sig_shares[i], doc)
     sig = pk_set.combine_signatures(sig_shares)
     assert pk_set.public_key().verify(sig, doc)
+
+
+def test_bivar_col_matches_full_evaluation_asymmetric():
+    """col(y).evaluate(x) must equal evaluate(x, y) even for MALICIOUSLY
+    ASYMMETRIC commitments (BivarCommitment.from_bytes accepts them
+    unvalidated), and col must NOT equal row there — the ack cross-check's
+    security depends on evaluating in the acker variable, not the
+    receiver's (sync_key_gen._apply_ack)."""
+    from hbbft_tpu.crypto.poly import BivarCommitment
+
+    g = MockGroup()
+    # asymmetric coefficient matrix: coeffs[i][j] != coeffs[j][i]
+    coeffs = [[1, 2, 3], [40, 5, 6], [700, 80, 9]]
+    c = BivarCommitment(g, coeffs)
+    for x in (1, 2, 5):
+        for y in (1, 3, 4):
+            assert c.col(y).evaluate(x) == c.evaluate(x, y)
+            assert c.row(x).evaluate(y) == c.evaluate(x, y)
+    # and the two projections genuinely differ on asymmetric input
+    assert c.col(2).coeffs != c.row(2).coeffs
