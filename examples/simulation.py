@@ -311,18 +311,43 @@ def run_array(args, backend, rng: random.Random) -> List[dict]:
     bad = [e for e in churn_at if not 0 <= e < args.epochs]
     if bad:  # validate BEFORE paying N-node key generation
         raise SystemExit(f"--churn-at indices out of range: {bad}")
-    net = ArrayHoneyBadgerNet(
-        range(args.num_nodes),
-        backend=backend,
-        seed=args.seed,
-        coin_rounds=getattr(args, "coin_rounds", 0),
-        dynamic=bool(churn_at),
-    )
+    if args.resume:
+        with open(args.resume, "rb") as fh:
+            net = ArrayHoneyBadgerNet.restore(fh.read(), backend)
+        if len(net.ids) != args.num_nodes:
+            raise SystemExit(
+                f"snapshot holds N={len(net.ids)} nodes, CLI says "
+                f"-n {args.num_nodes}"
+            )
+        if net.epoch >= args.epochs:
+            raise SystemExit(
+                f"snapshot already at epoch {net.epoch} >= --epochs {args.epochs}"
+            )
+        # explicit flags override; otherwise the snapshot's workload wins
+        # (a resumed soak must not silently change shape)
+        if args.coin_rounds is not None:
+            net.coin_rounds = args.coin_rounds
+        net.dynamic = net.dynamic or bool(churn_at)
+        print(
+            f"resumed array engine at epoch {net.epoch}, era {net.era}, "
+            f"coin_rounds={net.coin_rounds}, dynamic={net.dynamic}"
+        )
+    else:
+        net = ArrayHoneyBadgerNet(
+            range(args.num_nodes),
+            backend=backend,
+            seed=args.seed,
+            coin_rounds=args.coin_rounds or 0,
+            dynamic=bool(churn_at),
+        )
     rows: List[dict] = []
-    vtime = 0.0
+    vtime = getattr(net, "_cli_vtime", 0.0)
     wall0 = time.perf_counter()
-    delivered = 0
-    for epoch in range(args.epochs):
+    delivered = getattr(net, "_cli_delivered", 0)
+    # absolute epoch indices: a resumed run continues to the same total
+    # horizon the object engine uses (--epochs 2 --checkpoint, then
+    # --epochs 4 --resume runs epochs 2..3)
+    for epoch in range(net.epoch, args.epochs):
         if epoch in churn_at:
             crep = net.era_change()
             # fold the churn's network/rounds cost into the SAME virtual
@@ -376,6 +401,12 @@ def run_array(args, backend, rng: random.Random) -> List[dict]:
                 "dispatches": c.device_dispatches,
             }
         )
+    if args.checkpoint:
+        net._cli_vtime = vtime  # table continuity across resume
+        net._cli_delivered = delivered
+        with open(args.checkpoint, "wb") as fh:
+            fh.write(net.checkpoint())
+        print(f"checkpoint written to {args.checkpoint}")
     return rows
 
 
@@ -402,7 +433,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     )
     p.add_argument("--seed", type=int, default=0)
     p.add_argument(
-        "--coin-rounds", type=int, default=0, dest="coin_rounds",
+        "--coin-rounds", type=int, default=None, dest="coin_rounds",
         help="array engine: real threshold-sign coin rounds per BA "
         "instance (the split-input schedule; 0 = fixed-coin fast path)",
     )
@@ -415,7 +446,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         "--checkpoint",
         metavar="FILE",
         help="write a canonical whole-simulation snapshot here after the run "
-        "(object engine only)",
+        "(both engines)",
     )
     p.add_argument(
         "--resume",
@@ -435,8 +466,6 @@ def main(argv: Optional[List[str]] = None) -> int:
         f"batch={args.batch_size} backend={args.backend} engine={args.engine}"
     )
     if args.engine == "array":
-        if args.checkpoint or args.resume:
-            p.error("--checkpoint/--resume require the object engine")
         rows = run_array(args, backend, rng)
     else:
         if args.churn_at is not None or args.coin_rounds:

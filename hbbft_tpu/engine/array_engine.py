@@ -559,6 +559,27 @@ class ArrayHoneyBadgerNet:
         self.churn_reports.append(rep)
         return rep
 
+    def checkpoint(self) -> bytes:
+        """Whole-engine state (keys, era, epoch, RNG, reports) to canonical
+        snapshot bytes — the soak configs (BASELINE 3/5 at 1k epochs) are
+        resumable mid-run.  The crypto backend is environment, not state
+        (utils/snapshot.py contract)."""
+        from hbbft_tpu.utils.snapshot import save_node
+
+        return save_node(self)
+
+    @classmethod
+    def restore(cls, data: bytes, backend: CryptoBackend) -> "ArrayHoneyBadgerNet":
+        """Rebuild from :meth:`checkpoint` bytes; resumes byte-identically
+        (the RNG state round-trips, so epoch E+1 after restore equals
+        epoch E+1 of the uninterrupted run)."""
+        from hbbft_tpu.utils.snapshot import load_node
+
+        net = load_node(data, backend)
+        if not isinstance(net, cls):
+            raise TypeError(f"snapshot holds {type(net).__name__}")
+        return net
+
     def run_epochs(
         self,
         k: int,

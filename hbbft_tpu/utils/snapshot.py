@@ -78,6 +78,7 @@ _STATE_MODULES = (
     "hbbft_tpu.protocols.sync_key_gen",
     "hbbft_tpu.protocols.dynamic_honey_badger",
     "hbbft_tpu.protocols.transaction_queue",
+    "hbbft_tpu.engine.array_engine",
     "hbbft_tpu.protocols.queueing_honey_badger",
     "hbbft_tpu.protocols.sender_queue",
     "hbbft_tpu.utils.metrics",

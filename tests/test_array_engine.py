@@ -174,3 +174,22 @@ def test_run_epochs_churn_at():
     assert net.era == 2
     assert len(net.churn_reports) == 2
     assert len(net.reports) == 3
+
+
+def test_checkpoint_resume_byte_identical():
+    """Soak resumability (BASELINE configs 3/5 at 1k epochs): restoring a
+    checkpoint continues byte-identically with era and RNG state intact."""
+    a = ArrayHoneyBadgerNet(range(7), backend=MockBackend(), seed=5, dynamic=True)
+    a.run_epochs(2, payload_size=16, churn_at=[1])
+    blob = a.checkpoint()
+    cont = a.run_epochs(2, payload_size=16)
+    b = ArrayHoneyBadgerNet.restore(blob, MockBackend())
+    assert b.era == 1 and b.epoch == 2
+    cont2 = b.run_epochs(2, payload_size=16)
+    for x, y in zip(cont, cont2):
+        assert x[0] == y[0]
+    # corrupted snapshot fails loudly
+    import pytest as _pytest
+    from hbbft_tpu.utils.snapshot import SnapshotError
+    with _pytest.raises(SnapshotError):
+        ArrayHoneyBadgerNet.restore(b"HBTPUSNAP1garbage", MockBackend())
