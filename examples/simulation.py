@@ -211,6 +211,11 @@ class Simulation:
         from hbbft_tpu.utils.snapshot import SnapshotError, load_node
 
         state = load_node(blob, self.backend)
+        if not isinstance(state, dict) or "algos" not in state:
+            raise SnapshotError(
+                f"snapshot holds {type(state).__name__}, not an object-engine "
+                "simulation (array snapshots resume via --engine array)"
+            )
         snap_ids = sorted(state["algos"])
         if len(snap_ids) != self.args.num_nodes:
             raise SnapshotError(
@@ -323,6 +328,12 @@ def run_array(args, backend, rng: random.Random) -> List[dict]:
             raise SystemExit(
                 f"snapshot already at epoch {net.epoch} >= --epochs {args.epochs}"
             )
+        stale = [e for e in churn_at if e < net.epoch]
+        if stale:
+            raise SystemExit(
+                f"--churn-at {sorted(stale)} precede the snapshot's epoch "
+                f"{net.epoch}; churn indices are absolute"
+            )
         # explicit flags override; otherwise the snapshot's workload wins
         # (a resumed soak must not silently change shape)
         if args.coin_rounds is not None:
@@ -340,10 +351,14 @@ def run_array(args, backend, rng: random.Random) -> List[dict]:
             coin_rounds=args.coin_rounds or 0,
             dynamic=bool(churn_at),
         )
+    # Tables are PER-RUN (virtual clock, msgs, and the cumulative crypto
+    # counters all start at this run's zero — backend counters are
+    # environment, not snapshot state); only the epoch INDEX is absolute,
+    # so concatenated soak tables line up by epoch without mixing bases.
     rows: List[dict] = []
-    vtime = getattr(net, "_cli_vtime", 0.0)
+    vtime = 0.0
     wall0 = time.perf_counter()
-    delivered = getattr(net, "_cli_delivered", 0)
+    delivered = 0
     # absolute epoch indices: a resumed run continues to the same total
     # horizon the object engine uses (--epochs 2 --checkpoint, then
     # --epochs 4 --resume runs epochs 2..3)
@@ -402,8 +417,6 @@ def run_array(args, backend, rng: random.Random) -> List[dict]:
             }
         )
     if args.checkpoint:
-        net._cli_vtime = vtime  # table continuity across resume
-        net._cli_delivered = delivered
         with open(args.checkpoint, "wb") as fh:
             fh.write(net.checkpoint())
         print(f"checkpoint written to {args.checkpoint}")

@@ -575,9 +575,14 @@ class ArrayHoneyBadgerNet:
         epoch E+1 of the uninterrupted run)."""
         from hbbft_tpu.utils.snapshot import load_node
 
+        from hbbft_tpu.utils.snapshot import SnapshotError
+
         net = load_node(data, backend)
         if not isinstance(net, cls):
-            raise TypeError(f"snapshot holds {type(net).__name__}")
+            raise SnapshotError(
+                f"snapshot holds {type(net).__name__}, not {cls.__name__} "
+                "(object-engine snapshots resume via Simulation.from_checkpoint)"
+            )
         return net
 
     def run_epochs(

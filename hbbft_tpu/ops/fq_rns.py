@@ -224,13 +224,25 @@ def _mod_lanes(x: jnp.ndarray, p, invp) -> jnp.ndarray:
     return x
 
 
+def _mod_loose(x: jnp.ndarray, p, invp) -> jnp.ndarray:
+    """One-pass reduction to (−p, 2p) — same congruence class, no clamps.
+
+    Sufficient wherever only the f32 exactness budget matters (products
+    with an 11-bit operand stay < 3p·p < 2^24); the full clamped form is
+    reserved for values whose INTEGER range matters: the CRT digits σ/ξ
+    (a negative digit would make the reconstructed q̂/r negative and wrap
+    the S-K extension) and the S-K correction δ."""
+    return x - jnp.floor(x * invp) * p
+
+
 def carry3(x: jnp.ndarray) -> jnp.ndarray:
     """Representation-normalization hook (fq.carry3 analogue): reduce
-    every lane to its canonical residue range.  NOTE: lane reduction
-    only — the represented VALUE is unchanged (RNS lanes cannot shrink a
-    value; see reduce_small for that)."""
+    every lane into (−p, 2p) — enough that lane products stay f32-exact
+    ((2·2047)² < 2^24).  NOTE: lane reduction only — the represented
+    VALUE is unchanged (RNS lanes cannot shrink a value; see
+    reduce_small for that)."""
     x = jnp.asarray(x, DTYPE)
-    return _mod_lanes(x, _P_J, _INVP_J)
+    return _mod_loose(x, _P_J, _INVP_J)
 
 
 def reduce_small(x: jnp.ndarray) -> jnp.ndarray:
@@ -316,9 +328,12 @@ def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     constant-matrix base extensions; no convolution, no carries."""
     a = carry3(a)
     b = carry3(b)
-    x = _mod_lanes(a * b, _P_J, _INVP_J)  # products < 2^22, exact
-    # sign offset (multiple of Q): the reduced integer is non-negative
-    x = _mod_lanes(x + _X_OFF_J, _P_J, _INVP_J)
+    # sign offset (multiple of Q) keeps the reduced integer non-negative;
+    # x lanes stay UNREDUCED in (−p, 3p): both downstream products still
+    # fit the exact envelope (3p·p ≈ 2^23.6 < 2^24, ~25% headroom — any
+    # widening of the offset or the primes must re-derive this), saving a
+    # full-width reduction stage.
+    x = _mod_loose(a * b, _P_J, _INVP_J) + _X_OFF_J  # lanes in (−p, 3p)
 
     # σ_i = (−x·Q⁻¹ mod M1)·(M1/p_i)⁻¹ mod p_i, constants fused.
     p1, ip1 = _P_J[_S1], _INVP_J[_S1]
@@ -331,7 +346,8 @@ def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     # r = (x + q̂·Q)/M1 over B2 ∪ {m_r}: expanded as x·M1⁻¹ + q̂·(Q·M1⁻¹)
     # — both products < 2^22, so ONE reduction covers the sum.
     x2r = jnp.concatenate([x[..., _S2], x[..., _SR]], axis=-1)
-    r2r = _mod_lanes(
+    # |x2r|·M1⁻¹ < 3p·p ≈ 2^23.6 and qhat is clamped [0,p) → sum < 2^24
+    r2r = _mod_loose(
         x2r * _M1INV_B2R_J + qhat * _QM1INV_B2R_J, _P_B2R, _INVP_B2R
     )
     r2 = r2r[..., :N_B]
@@ -346,7 +362,7 @@ def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     delta = _mod_lanes(
         (raw_mr - r_mr) * _M2INV_R_J, _MR_P_J, _MR_INVP_J
     )  # δ ≤ 39 < m_r — exact
-    r1 = _mod_lanes(raw1 - delta * _M2_B1_J, p1, ip1)
+    r1 = _mod_loose(raw1 - delta * _M2_B1_J, p1, ip1)
     return jnp.concatenate([r1, r2, r_mr], axis=-1)
 
 
