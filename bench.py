@@ -615,47 +615,93 @@ def bench_epochs_n100() -> dict:
 
     Wall-clock here is dominated by the host protocol layer (pure-Python
     message handling) — this measures the whole framework, not the device
-    kernel.  BENCH_N100_BACKEND=tpu routes the crypto through the device."""
+    kernel.  BENCH_N100_BACKEND=tpu routes the crypto through the device.
+
+    BASELINE.md: single-core Rust at N=100 estimated ~0.1 epochs/s
+    (O(N²)≈20k pairings/epoch at ~1-2k pairings/s/core ≈ 10s/epoch)."""
+    return _bench_object_runtime(
+        "hbbft_epochs_per_sec_n100",
+        n=100,
+        f=33,
+        env_prefix="BENCH_N100",
+        default_epochs=1,
+        default_txns=200,
+        baseline_eps=0.1,
+        # This row measures the per-message OBJECT runtime — the
+        # correctness/adversarial harness.  The throughput story at this
+        # shape is array_epochs_per_sec_n100 (lockstep array engine).
+        extra_fields={"role": "correctness-harness"},
+    )
+
+
+def _bench_object_runtime(
+    metric: str,
+    n: int,
+    f: int,
+    env_prefix: str,
+    default_epochs: int,
+    default_txns: int,
+    baseline_eps: float,
+    extra_fields: dict,
+) -> dict:
+    """Shared body of the object-runtime rows (configs 0 and 3): build a
+    Simulation at the given shape and time its epochs."""
     import random
 
     from examples.simulation import Simulation, make_backend
 
     class A:  # argparse stand-in
-        num_nodes = 100
-        num_faulty = 33
-        batch_size = _env_int("BENCH_N100_BATCH", 100)
+        num_nodes = n
+        num_faulty = f
+        batch_size = _env_int(f"{env_prefix}_BATCH", 100)
         tx_size = 10
-        txns = _env_int("BENCH_N100_TXNS", 200)
-        epochs = _env_int("BENCH_N100_EPOCHS", 1)
+        txns = _env_int(f"{env_prefix}_TXNS", default_txns)
+        epochs = _env_int(f"{env_prefix}_EPOCHS", default_epochs)
         lam = 100.0
         bandwidth = 2000.0
         cpu_factor = 1.0
-        crypto_window = 256
+        crypto_window = _env_int(f"{env_prefix}_WINDOW", 256)
         seed = 0
 
-    backend = make_backend(os.environ.get("BENCH_N100_BACKEND", "mock"))
+    backend = make_backend(os.environ.get(f"{env_prefix}_BACKEND", "mock"))
     sim = Simulation(A, backend, random.Random(0))
     t0 = time.perf_counter()
     rows = sim.run()
     dt = time.perf_counter() - t0
-    epochs = len(rows)
-    eps = epochs / dt if dt > 0 else 0.0
-    # BASELINE.md: single-core Rust at N=100 estimated ~0.1 epochs/s
-    # (O(N²)≈20k pairings/epoch at ~1-2k pairings/s/core ≈ 10s/epoch).
+    eps = len(rows) / dt if dt > 0 else 0.0
     return {
-        "metric": "hbbft_epochs_per_sec_n100",
+        "metric": metric,
         "value": round(eps, 4),
         "unit": "epochs/s",
-        "vs_baseline": round(eps / 0.1, 3),
+        "vs_baseline": round(eps / baseline_eps, 3),
         "baseline": "estimated",
-        "epochs_measured": epochs,
+        "epochs_measured": len(rows),
         "backend": backend.name,
-        # This row measures the per-message OBJECT runtime — the
-        # correctness/adversarial harness.  The throughput story at this
-        # shape is array_epochs_per_sec_n100 (lockstep array engine).
         "runtime": "object",
-        "role": "correctness-harness",
+        **extra_fields,
     }
+
+
+def bench_epochs_n4() -> dict:
+    """BASELINE config 0 shape: HoneyBadger N=4 f=1, 10 epochs, 100
+    txns/batch — the CPU-reference configuration, run through the OBJECT
+    runtime (the per-message semantics the reference measures).
+    BENCH_N4_BACKEND=cpu gives the honest single-core real-crypto
+    reference point; mock (default) measures the protocol layer.
+    BENCH_N4_TXNS must scale with BENCH_N4_EPOCHS (~25 consumed per node
+    per epoch) or the queue drains early — epochs_measured reports what
+    actually ran."""
+    # single-core Rust at N=4: ~128 pairings/epoch at ~1k/s ≈ 7 epochs/s
+    return _bench_object_runtime(
+        "hbbft_epochs_per_sec_n4",
+        n=4,
+        f=1,
+        env_prefix="BENCH_N4",
+        default_epochs=_env_int("BENCH_N4_EPOCHS", 10),
+        default_txns=40 * _env_int("BENCH_N4_EPOCHS", 10),
+        baseline_eps=7.0,
+        extra_fields={},
+    )
 
 
 def _bench_array_engine(
@@ -1012,6 +1058,8 @@ def main() -> None:
     ]
     if os.environ.get("BENCH_FQ", "1") != "0":
         extra.append(("fq_kernel", bench_fq_kernel))
+    if os.environ.get("BENCH_N4", "1") != "0":
+        extra.append(("n4", bench_epochs_n4))
     if os.environ.get("BENCH_N100", "1") != "0":
         extra.append(("n100", bench_epochs_n100))
     if os.environ.get("BENCH_ARRAY", "1") != "0":
