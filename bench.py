@@ -692,13 +692,14 @@ def bench_epochs_n4() -> dict:
     per epoch) or the queue drains early — epochs_measured reports what
     actually ran."""
     # single-core Rust at N=4: ~128 pairings/epoch at ~1k/s ≈ 7 epochs/s
+    epochs = _env_int("BENCH_N4_EPOCHS", 10)
     return _bench_object_runtime(
         "hbbft_epochs_per_sec_n4",
         n=4,
         f=1,
         env_prefix="BENCH_N4",
-        default_epochs=_env_int("BENCH_N4_EPOCHS", 10),
-        default_txns=40 * _env_int("BENCH_N4_EPOCHS", 10),
+        default_epochs=epochs,
+        default_txns=40 * epochs,
         baseline_eps=7.0,
         extra_fields={},
     )

@@ -36,9 +36,10 @@ Value discipline (mirrors fq.py's lazy residues): a represented VALUE may
 be any integer with |v| < 2^16·Q; ``add``/``sub``/``neg`` are pointwise
 and lazy (residues drift above p and below 0), ``mul`` renormalizes its
 own inputs.  Closure: with M1 > 2^34·Q, a Montgomery product of two
-in-domain values is < 41·Q, so hundreds of chained adds — and pointwise
-small-constant scales up to 64 — stay in-domain, wider than the
-dozen-add discipline the tower relies on (ops/tower.py).
+in-domain values is < 41·Q, so hundreds of chained adds stay in-domain
+— wider than the dozen-add discipline the tower relies on
+(ops/tower.py).  mul_small renormalizes too, so small-constant scalings
+compose safely.
 
 Reference analogue: the `ff`/`pairing` crates' 64-bit Montgomery limbs
 under threshold_crypto (SURVEY.md §2.2) — redesigned a second time, now
@@ -386,14 +387,13 @@ def mul_n(pairs) -> list:
 def mul_small(a: jnp.ndarray, k: int) -> jnp.ndarray:
     """Multiply by a small int, |k| < 2^15 (fq.mul_small contract).
 
-    |k| ≤ 64 scales pointwise (value grows by k — the lazy domain covers
-    it); larger k routes through a full Montgomery product with the
-    residues of k·M1 so the value renormalizes to < 41·Q."""
+    Always routes through a full Montgomery product with the residues of
+    k·M1, so the value renormalizes to < 41·Q.  (A lazy pointwise scale
+    would be cheaper per call but lets CHAINS of small scalings escape
+    the 2^16·Q value domain silently — the renormalizing form makes
+    mul_small composition-safe like mul itself.)"""
     if not -(1 << 15) < k < (1 << 15):
         raise ValueError("|k| must be < 2^15")
-    if -64 <= k <= 64:
-        a = carry3(a)
-        return _mod_lanes(a * jnp.asarray(float(k), DTYPE), _P_J, _INVP_J)
     return mul(a, jnp.asarray(from_int(k)))
 
 

@@ -82,7 +82,7 @@ def test_deep_linear_chain_via_reduce_small(rng):
     assert R.to_int(np.asarray(acc)) == accv
 
 
-def test_mul_small_both_routes(rng):
+def test_mul_small_range(rng):
     a = rng.randrange(Q)
     for k in (0, 1, 2, 3, 12, 64, -64, 65, -65, 4097, 32767, -32767):
         got = R.to_int(np.asarray(R.mul_small(_dev(a), k)))
@@ -155,3 +155,49 @@ print("FACADE_OK")
         cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
     )
     assert "FACADE_OK" in proc.stdout, proc.stdout + proc.stderr
+
+
+from hypothesis import HealthCheck, given, settings, strategies as st
+
+
+@given(
+    ops=st.lists(
+        st.tuples(
+            st.sampled_from(["add", "sub", "mul", "neg", "small"]),
+            st.integers(0, Q - 1),
+            st.integers(-(1 << 15) + 1, (1 << 15) - 1),
+        ),
+        min_size=1,
+        max_size=12,
+    ),
+    seed=st.integers(0, Q - 1),
+)
+@settings(
+    max_examples=30,
+    deadline=None,
+    suppress_health_check=[HealthCheck.too_slow],
+)
+def test_random_op_sequences_match_python(ops, seed):
+    """Arbitrary interleavings of lazy adds/subs/negs, Montgomery muls and
+    small scalings agree with Python-int field arithmetic — the lazy
+    value-domain closure argument (|v| < 2^16·Q) holds on every prefix
+    because each mul/mul_small renormalizes and chains are ≤ 12 ops."""
+    acc = jnp.asarray(R.from_int(seed))
+    ref = seed
+    for kind, operand, k in ops:
+        if kind == "add":
+            acc = R.add(acc, jnp.asarray(R.from_int(operand)))
+            ref = ref + operand
+        elif kind == "sub":
+            acc = R.sub(acc, jnp.asarray(R.from_int(operand)))
+            ref = ref - operand
+        elif kind == "neg":
+            acc = R.neg(acc)
+            ref = -ref
+        elif kind == "mul":
+            acc = R.mul(acc, jnp.asarray(R.from_int(operand)))
+            ref = ref * operand
+        else:  # small
+            acc = R.mul_small(acc, k)
+            ref = ref * k
+    assert R.to_int(np.asarray(acc)) == ref % Q
