@@ -7,7 +7,8 @@ random-linear-combination verification at the config-1 shape: 64
 ciphertext groups × 16 shares).  The O(N²) such checks per epoch are the
 whole HBBFT performance story (SURVEY.md §3.2).
 
-Further metrics:
+Further metrics (one JSON line each; the LAST line printed is the
+north-star array_epochs_per_sec_n100 row):
 
 * ``share_verify_throughput``    — full BLS12-381 pairing-equation checks
   e(a1,b1)==e(a2,b2) (two Miller loops + one shared fast final
@@ -18,12 +19,23 @@ Further metrics:
   common-coin shape (config 2: N=64-ish coin instances × shares each).
 * ``g2_sign_throughput``         — batched 254-bit G2 ladders (the sign op
   behind "10k coin flips vmapped", config 2).
-* ``rs_encode_throughput``       — GF(2⁸) Reed–Solomon parity as int8 MXU
-  bit-matmul at the N=100 broadcast shape (34 data + 66 parity shards).
-* ``hbbft_epochs_per_sec_n100``  — the north-star macro config (N=100
-  f=33) driven end-to-end through VirtualNet + MockBackend (the host
-  protocol layer is the bottleneck being measured; set BENCH_N100=0 to
-  skip, BENCH_N100_BACKEND=tpu for the device-crypto variant).
+* ``coin_flips_per_sec``         — config 2 END TO END: batched sign →
+  grouped-RLC verify → batched Lagrange combine → parity, per flip.
+* ``rlc_dec_verify_adversarial`` — the flagship shape with 1-5% forged
+  shares through the bisecting fallback (adversarial throughput).
+* ``fq_mul_throughput``          — raw field-multiply kernel, RNS vs limb
+  (subprocess A/B; BENCH_FQ=0 skips).
+* ``rs_encode_throughput``       — GF(2⁸) Reed–Solomon parity as an MXU
+  bit-matmul at the N=100 broadcast shape (HBBFT_TPU_GF_DOT=bf16 A/B).
+* ``hbbft_epochs_per_sec_n4``    — BASELINE config 0 (N=4 f=1, object
+  runtime; BENCH_N4_BACKEND=cpu for the single-core real-crypto point).
+* ``hbbft_epochs_per_sec_n100``  — the north-star shape through the
+  per-message OBJECT runtime (labeled correctness-harness; the
+  throughput row is the array engine's).
+* ``array_epochs_per_sec_*``     — lockstep array-engine macro rows:
+  n16 real-crypto, n64 with real coin rounds, n256 soak (10 epochs),
+  n100 dedup, and the 100-epoch n100 row with one timed mid-run era
+  change (churn_epochs/era_change_seconds fields).
 
 ``vs_baseline`` on the flagship compares against 1_000 checks/sec — the
 order-of-magnitude single-core CPU pairing throughput BASELINE.md's cost
