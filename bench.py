@@ -56,6 +56,18 @@ import time
 
 CPU_BASELINE_CHECKS_PER_SEC = 1_000.0
 ARRAY_N16_METRIC = "array_epochs_per_sec_n16_realcrypto"
+#: bench names that execute the Fq facade (device field arithmetic)
+_FQ_ROWS = frozenset(
+    {
+        "rlc_dec",
+        "share_verify",
+        "rlc_sig",
+        "g2_sign",
+        "coin_e2e",
+        "rlc_dec_adversarial",
+        "array_n16_tpu",
+    }
+)
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
@@ -954,6 +966,12 @@ def _ensure_live_accelerator() -> None:
     env["JAX_PLATFORMS"] = "cpu"
     env["BENCH_PLATFORM_CHECKED"] = "1"
     env["BENCH_CPU_FALLBACK"] = "1"  # marks rows/shapes as degraded-mode
+    # On XLA:CPU the RNS field is ~10x the limb path on the raw kernel
+    # (PERF.md "Round 3: RNS" A/B, 2.5 vs 0.25 M muls/s) and 20-30x on
+    # the full verification graphs — degraded runs default to it.  The
+    # TPU path keeps the limb default until the on-chip A/B
+    # (tools/tpu_window.sh) settles promotion.
+    env.setdefault("HBBFT_TPU_FQ_IMPL", "rns")
     os.execve(sys.executable, [sys.executable] + sys.argv, env)
 
 
@@ -1061,6 +1079,7 @@ def main() -> None:
     # Ordered so the LAST line — the one a one-line reader (and the
     # driver's "parsed" field) lands on — is the north-star metric,
     # array_epochs_per_sec_n100.
+    global _FQ_ROWS
     extra = [
         ("share_verify", bench_share_verify),
         ("rlc_sig", bench_rlc_sig),
@@ -1158,6 +1177,15 @@ def main() -> None:
         try:
             row = _with_fallback(fn)
             row["platform"] = platform
+            fq_impl = os.environ.get("HBBFT_TPU_FQ_IMPL", "limb")
+            # label only rows whose bench executes the Fq facade (mock
+            # macros and the GF(2^8) RS row never touch field code)
+            uses_fq = name in _FQ_ROWS or str(row.get("backend", "")) in (
+                "TpuBackend",
+                "MeshBackend[8]",
+            )
+            if fq_impl != "limb" and uses_fq:
+                row["fq_impl"] = fq_impl
             print(json.dumps(row), flush=True)
         except Exception as e:  # one dead bench must not kill the others
             print(
