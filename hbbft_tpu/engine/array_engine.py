@@ -573,9 +573,7 @@ class ArrayHoneyBadgerNet:
         """Rebuild from :meth:`checkpoint` bytes; resumes byte-identically
         (the RNG state round-trips, so epoch E+1 after restore equals
         epoch E+1 of the uninterrupted run)."""
-        from hbbft_tpu.utils.snapshot import load_node
-
-        from hbbft_tpu.utils.snapshot import SnapshotError
+        from hbbft_tpu.utils.snapshot import SnapshotError, load_node
 
         net = load_node(data, backend)
         if not isinstance(net, cls):

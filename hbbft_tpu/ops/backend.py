@@ -188,9 +188,15 @@ class TpuBackend(CryptoBackend):
         h = self._h2_cache.get(doc)
         if h is None:
             h = self.group.hash_to_g2(doc)
-            if len(self._h2_cache) > 4096:
-                self._h2_cache.clear()
-            self._h2_cache[doc] = h
+            while len(self._h2_cache) >= 4096:
+                # bounded LRU, not a wholesale clear(): sign_shares_batch
+                # hashes every doc up front and the lane-cap recursion
+                # re-hashes per chunk, so one >4096-doc batch under
+                # clear() would thrash and re-run host hash-to-G2
+                self._h2_cache.pop(next(iter(self._h2_cache)))
+        else:
+            del self._h2_cache[doc]  # re-insert → most-recently-used
+        self._h2_cache[doc] = h
         return h
 
     def _check_batch(self, quads) -> List[bool]:

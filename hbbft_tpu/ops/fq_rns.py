@@ -89,6 +89,14 @@ for _p in B2:
 assert M1 > (Q << 34), "M1 must exceed 2^34*Q for the lazy-value closure"
 assert M2 > 80 * Q, "M2 must exceed the Montgomery-output bound"
 assert M_R > N_B + 2, "S-K correction digit must fit the redundant modulus"
+# The TIGHTEST f32-exactness bound any mul stage relies on (the fused r2r
+# reduction below): |x2r|·M1⁻¹ + q̂·(Q·M1⁻¹) with x2r ∈ (−p, 3p), q̂ ∈
+# [0, p), both constants < p → sum < 3p² + p² = 4p² ≈ 2^23.99 — only
+# ~0.9% under the 2^24 f32-exact envelope.  A larger prime base or a
+# wider sign offset could pass the looser bounds yet break this one, so
+# it is asserted at import time, not just in tests.
+_P_MAX = _ALL[0]  # descending sieve → largest base prime (2039)
+assert 4 * _P_MAX * _P_MAX < (1 << 24), "fused r2r reduction exceeds f32-exact"
 
 #: lane layout: [B1 | B2 | m_r]
 NLIMBS = 2 * N_B + 1

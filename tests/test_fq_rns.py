@@ -115,6 +115,9 @@ def test_exactness_margins():
     assert R.N_B * (pmax - 1) * 63 < 1 << 24
     # pointwise products of reduced lanes
     assert (pmax - 1) ** 2 < 1 << 24
+    # the TIGHTEST bound mul relies on: the fused r2r reduction's
+    # x2r·M1⁻¹ + q̂·(Q·M1⁻¹) sum, x2r ∈ (−p, 3p) → < 4p² (~0.9% margin)
+    assert 4 * pmax * pmax < 1 << 24
     # closure: M1 over the offset bound
     assert R.M1 > (Q << 34)
     assert R._X_OFFSET_INT % Q == 0
