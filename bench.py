@@ -966,21 +966,23 @@ def _ensure_live_accelerator() -> None:
         return
     # Fast path: tools/tpu_watch.sh probes the tunnel every 180 s and
     # maintains /tmp/tpu_alive (touched on success, removed on failure)
-    # plus /tmp/tpu_status.log.  A fresh watcher verdict makes the 180 s
-    # in-process probe redundant — a dead-tunnel bench run should reach
-    # its first row in seconds, not minutes (round-3 verdict Weak #6).
-    # BENCH_PROBE=force always pays the subprocess probe.
+    # plus /tmp/tpu_status.log.  A fresh watcher DEAD verdict skips the
+    # 180 s probe entirely — a dead-tunnel bench run reaches its first
+    # row in seconds, not minutes (round-3 verdict Weak #6).  A fresh
+    # ALIVE verdict does NOT skip the probe (the tunnel may have died
+    # since the watcher's last touch, and the first device op on a dead
+    # tunnel hangs forever) — it only shortens the probe timeout.
+    # BENCH_PROBE=force always pays the full probe.
+    probe_timeout = _env_int("BENCH_PROBE_TIMEOUT", 180)
     if os.environ.get("BENCH_PROBE", "") != "force":
         stale_after = float(os.environ.get("BENCH_WATCH_STALE", "400"))
         now = time.time()
         flag, log = "/tmp/tpu_alive", "/tmp/tpu_status.log"
         try:
             if os.path.exists(flag) and now - os.path.getmtime(flag) < stale_after:
-                os.environ["BENCH_PLATFORM_CHECKED"] = "1"
-                return
-            if (
-                not os.path.exists(flag)
-                and os.path.exists(log)
+                probe_timeout = _env_int("BENCH_PROBE_FAST_TIMEOUT", 45)
+            elif (
+                os.path.exists(log)
                 and now - os.path.getmtime(log) < stale_after
             ):
                 _reexec_on_cpu("watcher-confirmed dead tunnel")
@@ -997,7 +999,7 @@ def _ensure_live_accelerator() -> None:
             ],
             capture_output=True,
             text=True,
-            timeout=_env_int("BENCH_PROBE_TIMEOUT", 180),
+            timeout=probe_timeout,
         )
         alive = proc.returncode == 0 and "2.0" in proc.stdout
     except subprocess.TimeoutExpired:
@@ -1035,6 +1037,7 @@ def _clear_kernel_caches() -> None:
     for modname in (
         "hbbft_tpu.ops.backend",
         "hbbft_tpu.ops.fq_pallas",
+        "hbbft_tpu.ops.fq_rns_pallas",
         "hbbft_tpu.ops.pairing",
         "hbbft_tpu.ops.curve",
     ):

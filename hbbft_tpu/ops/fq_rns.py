@@ -48,6 +48,7 @@ for the MXU's constant-matrix contraction instead of add-with-carry.
 
 from __future__ import annotations
 
+import os
 from typing import List, Tuple
 
 import numpy as np
@@ -332,9 +333,36 @@ _MR_INVP_J = jnp.asarray(1.0 / M_R, DTYPE)
 _ONE_J = jnp.asarray(ONE)
 
 
+def _use_fused(which: str) -> bool:
+    """Route through the fused whole-mul Pallas kernel on TPU
+    (fq_rns_pallas; trace-time check, mirrors fq._use_pallas).
+
+    HBBFT_TPU_RNS_FUSED selects how much routes: ``pow`` (default) only
+    the fixed-exponent chains — the shape the round-2 on-chip record
+    shows fused kernels winning (one launch vs ~760 sequential
+    dispatches for the Fermat inverse); ``all`` additionally every mul
+    (per-mul fusion LOST the limb A/B on full verification graphs, so
+    this stays an A/B flag until tools/tpu_window.sh re-judges it for
+    RNS); ``0`` disables.  HBBFT_TPU_NO_PALLAS force-disables (bench.py's
+    compile-failure fallback ladder)."""
+    if os.environ.get("HBBFT_TPU_NO_PALLAS"):
+        return False
+    mode = os.environ.get("HBBFT_TPU_RNS_FUSED", "pow")
+    if mode == "0" or (mode != "all" and mode != which):
+        return False
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
 def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     """Montgomery product a·b·M1⁻¹ (mod Q) — 77 pointwise lanes plus two
     constant-matrix base extensions; no convolution, no carries."""
+    if _use_fused("mul"):
+        from hbbft_tpu.ops import fq_rns_pallas
+
+        return fq_rns_pallas.mul(a, b)
     a = carry3(a)
     b = carry3(b)
     # sign offset (multiple of Q) keeps the reduced integer non-negative;
@@ -407,6 +435,10 @@ def mul_small(a: jnp.ndarray, k: int) -> jnp.ndarray:
 
 def pow_fixed(x: jnp.ndarray, exponent: int) -> jnp.ndarray:
     """x^exponent (Montgomery chain; exponent baked into the graph)."""
+    if exponent >= 1 and _use_fused("pow"):
+        from hbbft_tpu.ops import fq_rns_pallas
+
+        return fq_rns_pallas.pow_fixed(x, exponent)
     bits = [int(b) for b in bin(exponent)[2:]]
     bits_arr = jnp.asarray(bits, dtype=jnp.int32)
 

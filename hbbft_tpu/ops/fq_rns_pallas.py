@@ -1,0 +1,394 @@
+"""Pallas TPU kernel: the ENTIRE full-RNS Montgomery multiply fused in VMEM.
+
+The XLA path (ops/fq_rns.mul) is ~15 elementwise stages around four small
+constant matmuls; between fused groups XLA materializes (lanes, 79) f32
+intermediates in HBM, and at ~3k VPU ops per mul against ~10 buffer
+round-trips the pipeline is HBM-bound (the round-3 roofline, PERF.md).
+This kernel keeps every stage — input renormalization, pointwise product,
+both Montgomery base extensions, the Shenoy–Kumaresan correction — in
+VMEM; HBM traffic drops to the two operands and the result.
+
+Layout inside the kernel is **residues-on-sublanes, lanes-on-minor**
+((80, T) tiles): stage k is then a full-width VPU op over all T lanes.
+The 79 residue rows are PADDED to 80 with one dead row between the bases:
+
+    rows  0:39  base B1          rows 40:79  base B2
+    row     39  pad (zeros)      row     79  m_r (S-K redundant modulus)
+
+so every slice the algorithm takes — B1∪pad = [0:40), B2∪m_r = [40:80) —
+starts on a sublane-aligned offset and has width 40.  (B2∪{m_r} is
+exactly the 40-wide unit the Montgomery pipeline works in: x2r, r2r and
+their constants; the pad row rides along with all-zero constants and
+stays identically zero.)
+
+The base-extension matmuls run on the MXU as EXPLICIT bf16 bit-planes:
+both the constant matrices (entry-split e = e_lo + 64·e_hi at module
+load, entries ≤ 63) and the 11-bit digit vectors (split in-kernel into a
+6-bit lo / 5-bit hi plane) are bf16-representable integers, products
+accumulate in f32 (exact: 40 terms of ≤ 63·63 < 2^18), and the weighted
+recombination reduces the hi partials before scaling so every sum stays
+under the 2^24 f32-exact envelope:
+
+    ll + 64·mod(lh + hl) + 4096·mod(hh)  ≤  155k + 131k + 8.39M  <  2^24
+
+This sidesteps any reliance on Mosaic's f32-dot precision lowering — the
+operands ARE bf16, exactly (the fq_rns.py:293 "bit-plane split" lever).
+
+Routing (see fq_rns._use_fused): HBBFT_TPU_RNS_FUSED=pow (default on TPU)
+routes only pow_fixed — the 380-iteration Fermat-inverse chain where the
+round-2 record shows fused kernels WIN (one launch vs ~760 dispatched
+stacked muls); =all also routes every mul (the per-mul A/B lost 1.4-2.6×
+for the LIMB kernels on-chip round 2 — the RNS re-match is a
+tools/tpu_window.sh item); =0 disables.  HBBFT_TPU_NO_PALLAS disables
+everything (bench.py's compile-failure fallback ladder relies on this).
+
+Falls back to interpret mode off-TPU, which is how the CPU suite
+golden-checks it (tests/test_fq_rns_pallas.py).
+
+Reference analogue: the `ff` crate's Montgomery multiply under
+threshold_crypto (SURVEY.md §2.2) — here as one resident-VMEM TPU kernel.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from hbbft_tpu.ops import fq_rns as R
+
+TILE = 512  # lanes per grid step: 4 × (8, 128) VPU tiles
+NROWS = 80  # 39 B1 + pad + 39 B2 + m_r
+_NB = R.N_B  # 39
+_PAD_P = 1031.0  # pad-row modulus: any positive value keeps 0 → 0 exact
+
+DTYPE = jnp.float32
+
+# -- constants in kernel layout (module load; Python ints → f32 columns) -----
+
+
+def _col(vals) -> np.ndarray:
+    return np.asarray(vals, dtype=np.float32).reshape(-1, 1)
+
+
+def _pad40(v39, pad=0.0) -> list:
+    return list(v39) + [pad]
+
+
+# full-width (80, 1) columns: [B1 | pad | B2 | m_r]
+_P80 = _col(R.B1 + [_PAD_P] + R.B2 + [R.M_R])
+_INVP80 = 1.0 / _P80
+_XOFF80 = _col(
+    [R._X_OFFSET_INT % p for p in R.B1]
+    + [0]
+    + [R._X_OFFSET_INT % p for p in R.B2]
+    + [R._X_OFFSET_INT % R.M_R]
+)
+
+# (40, 1) columns for the B1∪pad half (pad row constant 0 → stays zero)
+_SIGMA_C40 = _col(_pad40([float(c) for c in R._SIGMA_C_B1]))
+_P1_40 = _P80[:40]
+_INVP1_40 = _INVP80[:40]
+
+# (40, 1) columns for the B2∪m_r half (order [B2..., m_r] matches rows 40:80)
+_P2R_40 = _P80[40:]
+_INVP2R_40 = _INVP80[40:]
+_M1INV40 = _col([float(c) for c in R._M1INV_B2R])
+_QM1INV40 = _col([float(c) for c in R._QM1INV_B2R])
+_W2INV40 = _col(_pad40([float(c) for c in R._W2INV_B2]))  # 0 at the m_r row
+
+# ext-2 OUTPUT rows are B1∪{m_r}: [B1..., m_r] — row 39 is m_r here, so its
+# modulus column differs from _P1_40 at that row only.
+_PB1R40 = _col(_pad40(R.B1, pad=R.M_R))
+_INVPB1R40 = 1.0 / _PB1R40
+_M2B1R40 = _col(_pad40([float(c) for c in R._M2_B1]))  # 0 at the m_r row
+_M2INV_R = float(R._M2INV_R)
+_MR = float(R.M_R)
+
+# Extension matrices, transposed to (out=40, in=40) with a zero column for
+# the pad/dead input row, entry-split into bf16 planes (entries ≤ 63).
+def _ext_T_pad(e_lo: np.ndarray, e_hi: np.ndarray):
+    """(39, 40) split matrices → two (40, 40) bf16 operands E^T."""
+    def one(e):
+        t = np.zeros((40, 40), dtype=np.float32)
+        t[:, :_NB] = e.T  # out-rows × in-cols; input col 39 stays zero
+        return t
+    return one(e_lo), one(e_hi)
+
+
+_E1T_LO, _E1T_HI = _ext_T_pad(R._E1_LO, R._E1_HI)
+_E2T_LO, _E2T_HI = _ext_T_pad(R._E2_LO, R._E2_HI)
+# one packed (80, 80) input: [[E1T_LO, E1T_HI], [E2T_LO, E2T_HI]]
+_EMAT = np.block([[_E1T_LO, _E1T_HI], [_E2T_LO, _E2T_HI]])
+
+# Per-row constant vectors packed into ONE (80, 16) input (Pallas requires
+# array constants as inputs, not captures).  Columns 0-2 are full-width;
+# 40-row constants sit in the half of the column their consumer slices.
+_NCONST = 16
+
+
+def _pack_consts() -> np.ndarray:
+    c = np.zeros((NROWS, _NCONST), dtype=np.float32)
+    c[:, 0:1] = _P80
+    c[:, 1:2] = _INVP80
+    c[:, 2:3] = _XOFF80
+    c[:40, 3:4] = _SIGMA_C40
+    c[40:, 4:5] = _M1INV40
+    c[40:, 5:6] = _QM1INV40
+    c[40:, 6:7] = _W2INV40
+    c[:40, 7:8] = _PB1R40
+    c[:40, 8:9] = _INVPB1R40
+    c[:40, 9:10] = _M2B1R40
+    return c
+
+
+_CONSTS = _pack_consts()
+
+
+# -- kernel-internal stages ---------------------------------------------------
+
+
+def _mod_loose(x, p, invp):
+    """One-pass reduction to (−p, 2p) — fq_rns._mod_loose, column consts."""
+    return x - jnp.floor(x * invp) * p
+
+
+def _mod_lanes(x, p, invp):
+    """Exact reduction to [0, p) — fq_rns._mod_lanes, column consts."""
+    x = x - jnp.floor(x * invp) * p
+    x = x - p * (x >= p).astype(DTYPE)
+    x = x + p * (x < 0).astype(DTYPE)
+    return x
+
+
+def _split_dot(elo, ehi, v, p, invp):
+    """mod-p rows of Eᵀ·v via four exact bf16 MXU passes.
+
+    v is an 11-bit digit block (40, T) in [0, p): split into a 6-bit lo
+    and 5-bit hi plane, multiply against the pre-split matrix planes, and
+    recombine with the hi partials reduced first (bounds in the module
+    docstring)."""
+    v_hi = jnp.floor(v * (1.0 / 64.0))
+    v_lo = v - 64.0 * v_hi
+    f32 = DTYPE
+
+    def dot(m, x):
+        return jax.lax.dot_general(
+            m.astype(jnp.bfloat16),
+            x.astype(jnp.bfloat16),
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=f32,
+        )
+
+    ll = dot(elo, v_lo)
+    mid = _mod_lanes(dot(elo, v_hi) + dot(ehi, v_lo), p, invp)
+    hh = _mod_lanes(dot(ehi, v_hi), p, invp)
+    return _mod_lanes(ll + 64.0 * mid + 4096.0 * hh, p, invp)
+
+
+def _mul_core(a, b, em, cm, reduced: bool):
+    """(80, T) CARRIED-or-lazy operands → (80, T) Montgomery product.
+
+    Mirrors fq_rns.mul stage for stage (same bounds, same comments there);
+    ``cm`` is the packed (80, 16) constant matrix (_pack_consts);
+    ``reduced=True`` skips the input renormalization — valid whenever both
+    operands are outputs of this core (lanes already in (−p, 2p), so
+    |a·b| ≤ 4p² < 2^24 holds without the extra pass — the chain/pow
+    kernels' steady state)."""
+    p80, ip80 = cm[:, 0:1], cm[:, 1:2]
+    if not reduced:
+        a = _mod_loose(a, p80, ip80)
+        b = _mod_loose(b, p80, ip80)
+    x = _mod_loose(a * b, p80, ip80) + cm[:, 2:3]  # (−p, 3p)
+
+    p1, ip1 = cm[:40, 0:1], cm[:40, 1:2]
+    p2r, ip2r = cm[40:, 0:1], cm[40:, 1:2]
+    sigma = _mod_lanes(x[:40] * cm[:40, 3:4], p1, ip1)
+
+    qhat = _split_dot(em[:40, :40], em[:40, 40:], sigma, p2r, ip2r)
+
+    x2r = x[40:]
+    r2r = _mod_loose(x2r * cm[40:, 4:5] + qhat * cm[40:, 5:6], p2r, ip2r)
+
+    xi = _mod_lanes(r2r * cm[40:, 6:7], p2r, ip2r)
+    raw = _split_dot(em[40:, :40], em[40:, 40:], xi, cm[:40, 7:8], cm[:40, 8:9])
+
+    delta = _mod_lanes(
+        (raw[39:40] - r2r[39:40]) * _M2INV_R, _MR, 1.0 / _MR
+    )  # δ ≤ 39 < m_r — exact
+    r1 = _mod_loose(raw - delta * cm[:40, 9:10], p1, ip1)
+    return jnp.concatenate([r1, r2r], axis=0)
+
+
+# -- kernels ------------------------------------------------------------------
+
+
+def _mul_kernel(a_ref, b_ref, em_ref, cm_ref, out_ref):
+    out_ref[:] = _mul_core(a_ref[:], b_ref[:], em_ref[:], cm_ref[:], reduced=False)
+
+
+def _chain_kernel(a_ref, b_ref, em_ref, cm_ref, out_ref, *, n: int):
+    """x ← x·b, n times, never leaving VMEM (kernel-bench ceiling probe)."""
+    em, cm = em_ref[:], cm_ref[:]
+    p80, ip80 = cm[:, 0:1], cm[:, 1:2]
+    b = _mod_loose(b_ref[:], p80, ip80)
+    x = _mod_loose(a_ref[:], p80, ip80)
+
+    def body(_, x):
+        return _mul_core(x, b, em, cm, reduced=True)
+
+    out_ref[:] = jax.lax.fori_loop(0, n, body, x)
+
+
+def _pow_kernel(bits_ref, x_ref, em_ref, cm_ref, out_ref):
+    """Square-and-multiply chain in ONE kernel (fq_pallas._pow_kernel's
+    shape: SMEM bit schedule, branch-free blend body)."""
+    em, cm = em_ref[:], cm_ref[:]
+    p80, ip80 = cm[:, 0:1], cm[:, 1:2]
+    x = _mod_loose(x_ref[:], p80, ip80)
+    nbits = bits_ref.shape[0]
+
+    def body(i, acc):
+        sq = _mul_core(acc, acc, em, cm, reduced=True)
+        withx = _mul_core(sq, x, em, cm, reduced=True)
+        return jnp.where(bits_ref[i] > 0, withx, sq)
+
+    # MSB is implicit: acc starts at x, loop covers bits [1, nbits).
+    out_ref[:] = jax.lax.fori_loop(1, nbits, body, x)
+
+
+@functools.lru_cache(maxsize=None)
+def _mul_call(n_tiles: int, interpret: bool):
+    return pl.pallas_call(
+        _mul_kernel,
+        out_shape=jax.ShapeDtypeStruct((NROWS, n_tiles * TILE), DTYPE),
+        grid=(n_tiles,),
+        in_specs=[
+            pl.BlockSpec((NROWS, TILE), lambda i: (0, i)),
+            pl.BlockSpec((NROWS, TILE), lambda i: (0, i)),
+            pl.BlockSpec((NROWS, NROWS), lambda i: (0, 0)),
+            pl.BlockSpec((NROWS, _NCONST), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((NROWS, TILE), lambda i: (0, i)),
+        interpret=interpret,
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def _chain_call(n_tiles: int, n: int, interpret: bool):
+    return pl.pallas_call(
+        functools.partial(_chain_kernel, n=n),
+        out_shape=jax.ShapeDtypeStruct((NROWS, n_tiles * TILE), DTYPE),
+        grid=(n_tiles,),
+        in_specs=[
+            pl.BlockSpec((NROWS, TILE), lambda i: (0, i)),
+            pl.BlockSpec((NROWS, TILE), lambda i: (0, i)),
+            pl.BlockSpec((NROWS, NROWS), lambda i: (0, 0)),
+            pl.BlockSpec((NROWS, _NCONST), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((NROWS, TILE), lambda i: (0, i)),
+        interpret=interpret,
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def _pow_call(n_tiles: int, nbits: int, interpret: bool):
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n_tiles,),
+        in_specs=[
+            pl.BlockSpec((NROWS, TILE), lambda i, *_: (0, i)),
+            pl.BlockSpec((NROWS, NROWS), lambda i, *_: (0, 0)),
+            pl.BlockSpec((NROWS, _NCONST), lambda i, *_: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((NROWS, TILE), lambda i, *_: (0, i)),
+    )
+    return pl.pallas_call(
+        _pow_kernel,
+        out_shape=jax.ShapeDtypeStruct((NROWS, n_tiles * TILE), DTYPE),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )
+
+
+# -- layout conversion + public wrappers -------------------------------------
+
+
+def _lane_count(shape) -> tuple:
+    lanes = 1
+    for d in shape[:-1]:
+        lanes *= d
+    return lanes, max(1, -(-lanes // TILE))
+
+
+def _to_rows(x: jnp.ndarray, lanes: int, n_tiles: int) -> jnp.ndarray:
+    """(..., 79) → padded kernel layout (80, n_tiles·TILE)."""
+    flat = x.reshape(lanes, R.NLIMBS).T
+    z = jnp.zeros((1, lanes), dtype=DTYPE)
+    rows = jnp.concatenate([flat[:_NB], z, flat[_NB:]], axis=0)
+    pad = n_tiles * TILE - lanes
+    return jnp.pad(rows, ((0, 0), (0, pad))) if pad else rows
+
+
+def _from_rows(out: jnp.ndarray, lanes: int, shape) -> jnp.ndarray:
+    body = jnp.concatenate([out[:_NB, :lanes], out[40:, :lanes]], axis=0)
+    return body.T.reshape(shape)
+
+
+def _prep(a, b):
+    shape = jnp.broadcast_shapes(jnp.shape(a), jnp.shape(b))
+    a = jnp.broadcast_to(jnp.asarray(a, DTYPE), shape)
+    b = jnp.broadcast_to(jnp.asarray(b, DTYPE), shape)
+    lanes, n_tiles = _lane_count(shape)
+    return shape, a, b, lanes, n_tiles
+
+
+def mul(a: jnp.ndarray, b: jnp.ndarray, interpret: bool = False) -> jnp.ndarray:
+    """Drop-in for fq_rns.mul: (..., 79) lazy residues in, same out."""
+    shape, a, b, lanes, n_tiles = _prep(a, b)
+    out = _mul_call(n_tiles, interpret)(
+        _to_rows(a, lanes, n_tiles),
+        _to_rows(b, lanes, n_tiles),
+        jnp.asarray(_EMAT),
+        jnp.asarray(_CONSTS),
+    )
+    return _from_rows(out, lanes, shape)
+
+
+def mul_chain(
+    a: jnp.ndarray, b: jnp.ndarray, n: int, interpret: bool = False
+) -> jnp.ndarray:
+    """n chained Montgomery products x ← x·b in one kernel launch."""
+    shape, a, b, lanes, n_tiles = _prep(a, b)
+    out = _chain_call(n_tiles, n, interpret)(
+        _to_rows(a, lanes, n_tiles),
+        _to_rows(b, lanes, n_tiles),
+        jnp.asarray(_EMAT),
+        jnp.asarray(_CONSTS),
+    )
+    return _from_rows(out, lanes, shape)
+
+
+def pow_fixed(x: jnp.ndarray, exponent: int, interpret: bool = False) -> jnp.ndarray:
+    """x^exponent (Python-int exponent ≥ 1) — one kernel launch.
+
+    Drop-in for fq_rns.pow_fixed; the Fermat-inverse chain (exponent
+    Q−2, 380 bits) is the shape this kernel exists for."""
+    if exponent < 1:
+        raise ValueError("pow_fixed kernel requires exponent >= 1")
+    bits = np.asarray([int(c) for c in bin(exponent)[2:]], dtype=np.int32)
+    shape = jnp.shape(x)
+    x = jnp.asarray(x, DTYPE)
+    lanes, n_tiles = _lane_count(shape)
+    out = _pow_call(n_tiles, len(bits), interpret)(
+        jnp.asarray(bits),
+        _to_rows(x, lanes, n_tiles),
+        jnp.asarray(_EMAT),
+        jnp.asarray(_CONSTS),
+    )
+    return _from_rows(out, lanes, shape)

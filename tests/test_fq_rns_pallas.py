@@ -1,0 +1,124 @@
+"""Interpret-mode goldens for the fused RNS Montgomery kernel
+(ops/fq_rns_pallas) against the XLA path (ops/fq_rns) and host ints.
+
+The kernel is numerically EXACT by construction (every bound derived in
+the module docstrings), so equality here is bit-for-bit on the
+represented values — any drift is a real bug, not tolerance noise.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+jnp = pytest.importorskip("jax.numpy")
+
+from hbbft_tpu.crypto.field import Q
+from hbbft_tpu.ops import fq_rns as R
+from hbbft_tpu.ops import fq_rns_pallas as K
+
+
+@pytest.fixture
+def rng():
+    return random.Random(20260801)
+
+
+def _lazy_stack(rng, n):
+    """Residue stacks exercising the LAZY domain, not just canonical
+    values: raw from_ints rows plus sums/differences/negations (lanes
+    drift above p and below 0 — exactly what mul must renormalize)."""
+    xs = [rng.randrange(Q) for _ in range(n)]
+    base = R.from_ints(xs)
+    lazy = np.concatenate(
+        [base, base[: n // 2] + base[n // 2 : 2 * (n // 2)], -base[:1]]
+    )
+    vals = xs + [
+        (xs[i] + xs[n // 2 + i]) % Q for i in range(n // 2)
+    ] + [(-xs[0]) % Q]
+    return jnp.asarray(lazy), vals
+
+
+def test_mul_golden_vs_xla_and_host(rng):
+    a, va = _lazy_stack(rng, 6)
+    b, vb = _lazy_stack(rng, 6)
+    got = np.asarray(K.mul(a, b, interpret=True))
+    want = np.asarray(R.mul(a, b))
+    assert R.to_ints(got) == R.to_ints(want)
+    # and against host integers (strip the shared Montgomery factor)
+    assert R.to_ints(got) == [x * y % Q for x, y in zip(va, vb)]
+
+
+def test_mul_broadcast_and_padding(rng):
+    # one lane vs a stack (broadcast), lane count far from a TILE multiple
+    n = 7
+    a, va = _lazy_stack(rng, n)
+    x = rng.randrange(Q)
+    b = jnp.asarray(R.from_int(x))
+    got = R.to_ints(np.asarray(K.mul(a, b, interpret=True)))
+    assert got == [v * x % Q for v in va]
+
+
+def test_mul_multi_tile(rng):
+    # lanes > TILE exercises the grid (2 tiles) without an interpret blowup
+    n = K.TILE + 3
+    xs = [rng.randrange(Q) for _ in range(8)]
+    a = jnp.asarray(np.tile(R.from_ints(xs[:4]), (n // 4 + 1, 1))[:n])
+    b = jnp.asarray(np.tile(R.from_ints(xs[4:]), (n // 4 + 1, 1))[:n])
+    got = R.to_ints(np.asarray(K.mul(a, b, interpret=True)))
+    want = [
+        xs[i % 4] * xs[4 + i % 4] % Q for i in range(n)
+    ]
+    assert got == want
+
+
+def test_mul_chain_golden(rng):
+    a, va = _lazy_stack(rng, 4)
+    b, vb = _lazy_stack(rng, 4)
+    steps = 5
+    got = R.to_ints(np.asarray(K.mul_chain(a, b, steps, interpret=True)))
+    # in represented values the Montgomery form cancels: x·b^steps
+    assert got == [x * pow(y, steps, Q) % Q for x, y in zip(va, vb)]
+
+
+def test_pow_golden(rng):
+    a, va = _lazy_stack(rng, 4)
+    e = 0b1011010111  # 10 bits: both branches of the blend, multi-iteration
+    got = R.to_ints(np.asarray(K.pow_fixed(a, e, interpret=True)))
+    assert got == [pow(v, e, Q) for v in va]
+    # parity with the XLA scan path
+    assert got == R.to_ints(np.asarray(R.pow_fixed(a, e)))
+
+
+def test_pow_exponent_one(rng):
+    a, va = _lazy_stack(rng, 3)
+    got = R.to_ints(np.asarray(K.pow_fixed(a, 1, interpret=True)))
+    assert got == va
+
+
+def test_facade_env_routing(rng, monkeypatch):
+    """The HBBFT_TPU_RNS_FUSED decision table — positive rows under a
+    mocked TPU backend (this suite runs on CPU), negative rows both ways
+    (on a real CPU backend the dispatch must NEVER route, or interpret
+    kernels would land in production graphs)."""
+    table = [
+        ("pow", "pow", True),
+        ("pow", "mul", False),
+        ("all", "mul", True),
+        ("all", "pow", True),
+        ("0", "pow", False),
+        ("0", "mul", False),
+    ]
+    # real CPU backend: never route, whatever the mode says
+    for mode, which, _ in table:
+        monkeypatch.setenv("HBBFT_TPU_RNS_FUSED", mode)
+        assert R._use_fused(which) is False
+    # mocked TPU backend: the table is the contract
+    monkeypatch.setattr(R.jax, "default_backend", lambda: "tpu")
+    for mode, which, want in table:
+        monkeypatch.setenv("HBBFT_TPU_RNS_FUSED", mode)
+        assert R._use_fused(which) is want, (mode, which)
+    monkeypatch.delenv("HBBFT_TPU_RNS_FUSED")
+    assert R._use_fused("pow") is True  # default mode is pow
+    assert R._use_fused("mul") is False
+    monkeypatch.setenv("HBBFT_TPU_NO_PALLAS", "1")
+    assert R._use_fused("pow") is False  # the bench fallback-ladder kill switch

@@ -94,6 +94,36 @@ def main():
     if os.environ.get("KB_NO_ROOFLINE"):
         return  # bench.py's subprocess A/B skips the fixed-size probe
 
+    # Fused whole-chain RNS kernel (ops/fq_rns_pallas.mul_chain): the
+    # entire Montgomery pipeline resident in VMEM, n muls per launch —
+    # the compute-ceiling probe for the ≥2G muls/s target (round-3
+    # verdict task 2).  TPU only (interpret mode would measure Python);
+    # KB_FUSED=interpret forces a tiny interpret-mode sanity run.
+    kb_fused = os.environ.get("KB_FUSED", "auto")
+    on_tpu = jax.default_backend() == "tpu"
+    if impl == "rns" and kb_fused != "0" and (on_tpu or kb_fused == "interpret"):
+        from hbbft_tpu.ops import fq_rns_pallas
+
+        interp = not on_tpu
+        chain = CHAIN if on_tpu else min(CHAIN, 4)
+        for lanes in LANES if on_tpu else [512]:
+            b = _rand_limbs(rng, lanes)
+            run = lambda aa: _fence(  # noqa: E731
+                fq_rns_pallas.mul_chain(aa, b, chain, interpret=interp)
+            )
+            run(_rand_limbs(rng, lanes))  # compile+warm
+            best = float("inf")
+            for _ in range(2):
+                a = _rand_limbs(rng, lanes)
+                _fence(a)
+                t0 = time.perf_counter()
+                run(a)
+                best = min(best, (time.perf_counter() - t0) / chain)
+            print(
+                f"lanes={lanes:7d}  fused-chain: {best*1e3:8.4f} ms  "
+                f"{lanes/best/1e6:8.2f} M muls/s (fq_rns_pallas)"
+            )
+
     # VPU roofline probe: same chain+fence discipline, pure FMA body.
     lanes = 262144
     rows = 50

@@ -1,42 +1,75 @@
 #!/bin/bash
 # TPU-window runbook: run this THE MOMENT /tmp/tpu_alive exists (the
-# tunnel died repeatedly in rounds 2-3; treat every live window as
+# tunnel died for all of rounds 2-3; treat every live window as
 # preemptible — capture in strict priority order, flush after each step).
 #
 #   bash tools/tpu_window.sh | tee -a /tmp/tpu_window.log
 #
-# Priority order (round-2 verdict Missing #1 / round-3 plan):
-#   1. full driver bench -> the official BENCH artifact rows, platform=tpu
-#      (includes the new coin_flips_per_sec, rlc_dec_verify_adversarial,
-#      100-epoch n100 macro with era change, 10-epoch n256 soak)
-#   2. kernel A/B limb vs RNS (tools/kernel_bench.py both impls)
-#   3. rlc_dec + coin rows under HBBFT_TPU_FQ_IMPL=rns (promotion A/B)
-#   4. N=100 real-crypto epoch (replaces PERF.md's "expected 180-200s")
-#   5. RS-encode profile (verdict Weak #6)
+# Round-4 priority order (VERDICT r3 "Next round" tasks 1-5):
+#   1. limb-vs-RNS kernel A/B on-chip (decides RNS default promotion)
+#      + the fused-chain VMEM-ceiling probe (fq_rns_pallas, task 2)
+#   2. flagship crypto rows + n16 real-crypto macro under RNS
+#   3. the same flagship subset under limb (graph-level A/B)
+#   4. N=100 f=33 real-crypto epochs (>=10, one era change) — the
+#      north star at its defined shape (task 3)
+#   5. config 2 at size: 10k coin flips, N=64 (task 5)
+#   6. full driver bench (fills every remaining row on TPU)
+#   7. RS encode int8-vs-bf16 dot A/B (task 4)
+#   8. per-mul fused RNS A/B (HBBFT_TPU_RNS_FUSED=all vs pow)
+# Each bench.py run OVERWRITES BENCH_rows.json with its own row set, so
+# a snapshot is copied to tpu_window_r04/ after every step — the
+# archive is the snapshot directory, and a dying tunnel can only lose
+# the CURRENT step.
 set -u
 cd "$(dirname "$0")/.."
 TS() { date -u +%H:%M:%S; }
+ART=tpu_window_r04
+mkdir -p "$ART"
+SNAP() { cp -f BENCH_rows.json "$ART/rows_after_$1.json" 2>/dev/null || true; }
 
-echo "=== $(TS) step 1: full driver bench (tpu) ==="
-# BENCH_FQ=0: step 2 runs the kernel A/B dedicated; keep step 1's budget
-# for the macro rows it exists to capture.
-BENCH_FQ=0 timeout 3600 python bench.py
+echo "=== $(TS) step 1: kernel A/B limb vs rns (+fused-chain probe) ==="
+timeout 1200 python tools/kernel_bench.py 2>&1 | tee "$ART/kernel_limb.log"
+HBBFT_TPU_FQ_IMPL=rns timeout 1800 python tools/kernel_bench.py 2>&1 \
+  | tee "$ART/kernel_rns.log"
 
-echo "=== $(TS) step 2: kernel A/B limb vs rns ==="
-timeout 1200 python tools/kernel_bench.py
-HBBFT_TPU_FQ_IMPL=rns timeout 1200 python tools/kernel_bench.py
-
-echo "=== $(TS) step 3: backend rows under rns ==="
-HBBFT_TPU_FQ_IMPL=rns BENCH_ONLY=rlc_dec,rlc_sig,coin_e2e,g2_sign,share_verify,rlc_dec_adversarial \
-  timeout 2400 python bench.py
-
-echo "=== $(TS) step 4: N=100 real-crypto array epoch ==="
-BENCH_ONLY=array_n100 BENCH_ARRAY_BACKEND=tpu BENCH_ARRAY_EPOCHS=1 BENCH_ARRAY_CHURN=0 \
+echo "=== $(TS) step 2: flagship rows + n16 real-crypto under rns ==="
+HBBFT_TPU_FQ_IMPL=rns \
+  BENCH_ONLY=rlc_dec,rlc_sig,coin_e2e,g2_sign,share_verify,rlc_dec_adversarial,array_n16_tpu \
   timeout 3600 python bench.py
+SNAP step2_rns
 
-echo "=== $(TS) step 5: RS encode (int8 vs bf16 dot A/B) ==="
+echo "=== $(TS) step 3: rlc_dec + coin under limb (graph A/B) ==="
+BENCH_ONLY=rlc_dec,coin_e2e timeout 1800 python bench.py
+SNAP step3_limb
+
+echo "=== $(TS) step 4: N=100 real-crypto epochs + era change ==="
+HBBFT_TPU_FQ_IMPL=rns BENCH_ONLY=array_n100 BENCH_ARRAY_BACKEND=tpu \
+  BENCH_ARRAY_EPOCHS=10 BENCH_ARRAY_CHURN=1 \
+  timeout 5400 python bench.py
+SNAP step4_n100
+
+echo "=== $(TS) step 5: config 2 at size (10k flips; n64 coin macro) ==="
+HBBFT_TPU_FQ_IMPL=rns BENCH_ONLY=coin_e2e BENCH_COIN_FLIPS=10000 \
+  timeout 3600 python bench.py
+SNAP step5_flips
+HBBFT_TPU_FQ_IMPL=rns BENCH_ONLY=array_n64_coin BENCH_COIN_MACRO_BACKEND=tpu \
+  timeout 1800 python bench.py
+SNAP step5_macro
+
+echo "=== $(TS) step 6: full driver bench (tpu; fq A/B inside) ==="
+HBBFT_TPU_FQ_IMPL=rns timeout 5400 python bench.py
+cp -f BENCH_rows.json "$ART/rows_full_rns.json" 2>/dev/null || true
+
+echo "=== $(TS) step 7: RS encode (int8 vs bf16 dot A/B) ==="
 BENCH_ONLY=rs_encode timeout 900 python bench.py
 BENCH_ONLY=rs_encode HBBFT_TPU_GF_DOT=bf16 timeout 900 python bench.py
-BENCH_ONLY=rs_encode HBBFT_TPU_GF_DOT=bf16 BENCH_RS_SHARD=65536 timeout 900 python bench.py
+BENCH_ONLY=rs_encode HBBFT_TPU_GF_DOT=bf16 BENCH_RS_SHARD=65536 \
+  timeout 900 python bench.py
+SNAP step7_rs
 
-echo "=== $(TS) done ==="
+echo "=== $(TS) step 8: per-mul fused RNS A/B on the flagship row ==="
+HBBFT_TPU_FQ_IMPL=rns HBBFT_TPU_RNS_FUSED=all BENCH_ONLY=rlc_dec \
+  timeout 1800 python bench.py
+SNAP step8_fused_all
+
+echo "=== $(TS) done — snapshots in $ART/ ==="
