@@ -60,9 +60,17 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+import os
+
 from hbbft_tpu.ops import fq_rns as R
 
-TILE = 512  # lanes per grid step: 4 × (8, 128) VPU tiles
+#: lanes per grid step (4 × (8, 128) VPU tiles by default).  Env knob for
+#: the on-chip tuning A/B (tools/tpu_window.sh): larger tiles amortize
+#: grid overhead, smaller ones overlap better with the extension matmuls.
+TILE = int(os.environ.get("HBBFT_TPU_RNS_TILE", "512"))
+# fail fast at import: 0 would divide-by-zero in _lane_count, and a
+# non-multiple of 128 dies deep in Mosaic lane tiling mid-window
+assert TILE > 0 and TILE % 128 == 0, f"HBBFT_TPU_RNS_TILE={TILE} not a multiple of 128"
 NROWS = 80  # 39 B1 + pad + 39 B2 + m_r
 _NB = R.N_B  # 39
 _PAD_P = 1031.0  # pad-row modulus: any positive value keeps 0 → 0 exact

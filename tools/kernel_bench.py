@@ -148,7 +148,10 @@ def main():
     print(
         f"VPU FMA roofline probe: {dt*1e3:.4f} ms/step  "
         f"{flops/dt/1e12:.3f} Tflop/s "
-        f"(= {flops/2/2500/dt/1e6:.1f} M conv-equiv muls/s)"
+        f"(= {flops/2/2500/dt/1e6:.1f} M conv-equiv muls/s, "
+        f"= {flops/2/5000/dt/1e6:.1f} M rns-fused-equiv at ~5k "
+        f"lane-ops/mul — the measured-ceiling yardstick for the fused "
+        f"chain)"
     )
 
 

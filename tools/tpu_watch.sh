@@ -5,7 +5,24 @@
 # Success = a small device matmul completes and fetches within the timeout
 # (same discipline as __graft_entry__._accelerator_alive: only a hang
 # counts as dead; the platform may report "tpu" or "axon").
+#
+# On a dead→alive TRANSITION the watcher AUTO-LAUNCHES the window
+# capture runbook (tools/tpu_window.sh) in the background, once per
+# window (lockfile): rounds 2-3 lost every window to timing, so capture
+# must not depend on a human/agent noticing the flag.  Disable with
+# TPU_WATCH_NO_CAPTURE=1 (e.g. while driving the window manually).
+cd "$(dirname "$0")/.."
+LOCK=/tmp/tpu_window_running
+# a stale flag from a previous watcher run would make the first ALIVE
+# probe read as "no transition" and skip that window's capture
+rm -f /tmp/tpu_alive
 while true; do
+  # reap a stale lock (capture killed before its rmdir): no live
+  # tpu_window.sh process → the lock cannot be protecting anything
+  if [ -d "$LOCK" ] && ! pgrep -f "bash tools/tpu_window.sh" >/dev/null; then
+    echo "$(date -u +%H:%M:%S) reaping stale capture lock" >> /tmp/tpu_status.log
+    rmdir "$LOCK" 2>/dev/null || true
+  fi
   ts=$(date -u +%H:%M:%S)
   out=$(timeout 120 python -c "
 import jax, numpy as np, jax.numpy as jnp
@@ -15,8 +32,16 @@ assert plat in ('tpu', 'axon'), plat  # a CPU fallback is NOT alive
 print('OK', plat, v)
 " 2>/dev/null | grep '^OK' | head -1)
   if [ -n "$out" ]; then
+    was_dead=1
+    [ -f /tmp/tpu_alive ] && was_dead=0
     echo "$ts ALIVE $out" >> /tmp/tpu_status.log
     touch /tmp/tpu_alive
+    if [ "$was_dead" = 1 ] && [ -z "${TPU_WATCH_NO_CAPTURE:-}" ] \
+        && mkdir "$LOCK" 2>/dev/null; then
+      echo "$ts auto-launching tpu_window.sh" >> /tmp/tpu_status.log
+      ( bash tools/tpu_window.sh >> /tmp/tpu_window.log 2>&1; \
+        rmdir "$LOCK" ) &
+    fi
   else
     echo "$ts dead" >> /tmp/tpu_status.log
     rm -f /tmp/tpu_alive
