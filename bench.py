@@ -824,6 +824,9 @@ def _bench_array_engine(
         dynamic=dynamic, coin_rounds=coin_rounds,
     )
     net.run_epochs(1, payload_size=64)  # warm: compile/caches
+    counters = getattr(backend, "counters", None)
+    ctr0 = counters.snapshot() if counters is not None else {}
+    churn_ctr = {"device_seconds": 0.0, "hash_g2_seconds": 0.0}
     # mid-run only: era changes need a preceding and a following epoch, so
     # indices clamp to [1, epochs-1] and dedupe (epochs < 2 → no churn; the
     # row's churn_epochs field reports what actually ran).
@@ -842,9 +845,14 @@ def _bench_array_engine(
     done = 0
     for e in range(epochs):
         if e in churn_at:
-            c0 = time.perf_counter()
+            t_ch = time.perf_counter()
+            pre = counters.snapshot() if counters is not None else {}
             net.era_change()
-            churn_time += time.perf_counter() - c0
+            if counters is not None:
+                d = counters.diff(pre)
+                for k in churn_ctr:  # excluded like churn_time is
+                    churn_ctr[k] += d.get(k, 0.0)
+            churn_time += time.perf_counter() - t_ch
         net.run_epochs(1, payload_size=64)
         done += 1
     dt = (time.perf_counter() - t0) - churn_time
@@ -865,6 +873,16 @@ def _bench_array_engine(
         "messages_per_epoch": rep.messages_delivered,
         "dec_share_verifies_per_epoch": rep.dec_shares_verified,
     }
+    if counters is not None and done:
+        # host/device attribution for the timed epochs (verdict task 8):
+        # device_seconds = dispatch+fetch wall of the dominant jitted
+        # calls, hash_g2_seconds = host EC hashing — both per
+        # steady-state epoch (era-change work excluded, like churn_time).
+        delta = counters.diff(ctr0)
+        for key in churn_ctr:
+            val = delta.get(key, 0.0) - churn_ctr[key]
+            if val > 0:
+                row[f"{key}_per_epoch"] = round(val / done, 4)
     if coin_rounds:
         row["coin_rounds_per_ba"] = coin_rounds
         row["coin_signs_per_epoch"] = rep.coin_signs

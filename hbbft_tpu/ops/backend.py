@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import functools
 import os
+import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -187,7 +188,9 @@ class TpuBackend(CryptoBackend):
     def _hash_g2(self, doc: bytes):
         h = self._h2_cache.get(doc)
         if h is None:
+            t0 = time.perf_counter()
             h = self.group.hash_to_g2(doc)
+            self.counters.hash_g2_seconds += time.perf_counter() - t0
             while len(self._h2_cache) >= 4096:
                 # bounded LRU, not a wholesale clear(): sign_shares_batch
                 # hashes every doc up front and the lane-cap recursion
@@ -221,9 +224,18 @@ class TpuBackend(CryptoBackend):
         )
         Q2 = pairing.g2_affine_to_device([q[3] for q in quads])
 
-        f = _jitted_product2()(*self._place((P1, Q1, P2, Q2)))
-        f = jax.tree_util.tree_map(np.asarray, f)
+        f = self._dispatch_fetch(_jitted_product2(), self._place((P1, Q1, P2, Q2)))
         return [pairing.is_one_host(f, i) for i in range(n)]
+
+    def _dispatch_fetch(self, jitted, args):
+        """Dispatch one jitted call and fetch the result to host, billing
+        the wall clock to counters.device_seconds (task-8 attribution —
+        includes any queued device work this fetch must wait for)."""
+        t0 = time.perf_counter()
+        out = jitted(*args)
+        out = jax.tree_util.tree_map(np.asarray, out)
+        self.counters.device_seconds += time.perf_counter() - t0
+        return out
 
     # -- grouped (random-linear-combination) verification --------------------
     #
@@ -320,8 +332,7 @@ class TpuBackend(CryptoBackend):
             self.counters.device_dispatches += 1
             args = build_group_arrays(padded, g, k)
             placed = self._place(tuple(args) + (jnp.asarray(rbits),))
-            f = jitted(*placed)
-            f = jax.tree_util.tree_map(np.asarray, f)
+            f = self._dispatch_fetch(jitted, placed)
             next_pending: List[List[int]] = []
             for gi, grp in enumerate(pending):
                 if pairing.is_one_host(f, gi):
@@ -645,7 +656,11 @@ class TpuBackend(CryptoBackend):
             pts = pts + [pts[0]] * (b - n)
         P = to_device(pts)
         self.counters.device_dispatches += 1
-        out = jitted(*self._place((P, jnp.asarray(bits), jnp.asarray(negs))))
+        out = self._dispatch_fetch(
+            jitted, self._place((P, jnp.asarray(bits), jnp.asarray(negs)))
+        )
+        # from_device's per-lane host affine conversion runs on fetched
+        # numpy arrays — host work, deliberately NOT billed as device
         return from_device(out)[:n]
 
     def sign_shares_batch(
@@ -758,7 +773,7 @@ class TpuBackend(CryptoBackend):
         bits = jnp.asarray(np.stack(bits_rows))
         negs = jnp.asarray(np.array(negs_rows))
         self.counters.device_dispatches += 1
-        return jitted(*self._place((P, bits, negs)))
+        return self._dispatch_fetch(jitted, self._place((P, bits, negs)))
 
     def _combine_sig_chunk(self, pk_set, items, idxs, k, out) -> None:
         combined = self._lagrange_chunk(
