@@ -517,7 +517,7 @@ class TpuBackend(CryptoBackend):
             [s for s, _ in safe] + [0] * (b - len(pts))
         )
         negs = np.array([n for _, n in safe] + [False] * (b - len(pts)))
-        combined = jitted(to_device(points), bits, negs)
+        combined = self._dispatch_fetch(jitted, (to_device(points), bits, negs))
         return from_device(combined)[0]
 
     def _lagrange_device_g2(self, pts: List[Tuple[int, Any]]):

@@ -1,0 +1,78 @@
+"""Legacy TestNetwork compat harness (net/test_network.py) — the old
+step-wise API must drive real consensus over the VirtualNet machinery."""
+
+import dataclasses
+
+import pytest
+
+from hbbft_tpu.net.test_network import (
+    FlipBoolAdversary,
+    MessageScheduler,
+    SilentAdversary,
+    TestNetwork,
+)
+from hbbft_tpu.protocols.binary_agreement import BinaryAgreement
+
+
+def _ba(netinfo, backend):
+    return BinaryAgreement(netinfo, backend, session_id=b"legacy")
+
+
+@pytest.mark.parametrize("sched", [MessageScheduler.RANDOM, MessageScheduler.FIRST])
+def test_ba_agreement_both_schedulers(sched):
+    net = TestNetwork(4, 0, _ba, scheduler=sched, seed=11)
+    for i in range(4):
+        net.input(i, i % 2 == 0)
+    outs = net.run()
+    assert len(outs) == 4
+    vals = {tuple(v) for v in outs.values()}
+    assert len(vals) == 1 and all(len(v) == 1 for v in outs.values())
+
+
+def test_stepwise_api_delivers_one_message_per_step():
+    net = TestNetwork(4, 0, _ba, scheduler=MessageScheduler.FIRST, seed=3)
+    net.input_all(True)
+    before = net.net.messages_delivered
+    got = net.step()
+    assert got is not None and net.net.messages_delivered == before + 1
+    outs = net.run()
+    assert {tuple(v) for v in outs.values()} == {(True,)}
+
+
+def test_silent_adversary_crash_faults_tolerated():
+    net = TestNetwork(6, 1, _ba, adversary=SilentAdversary(), seed=5)
+    net.input_all(True)
+    outs = net.run()
+    # correct nodes decide despite the crashed (silent) faulty node
+    assert len(outs) == 6
+    assert {tuple(v) for v in outs.values()} == {(True,)}
+
+
+def test_flip_bool_adversary_payload_flip():
+    adv = FlipBoolAdversary()
+
+    @dataclasses.dataclass(frozen=True)
+    class Inner:
+        b: bool
+        n: int
+
+    @dataclasses.dataclass(frozen=True)
+    class Msg:
+        kind: str
+        flag: bool
+        inner: Inner
+
+    flipped = adv._flip_payload(Msg("x", True, Inner(False, 3)))
+    assert flipped.flag is False and flipped.inner.b is True
+    assert flipped.kind == "x" and flipped.inner.n == 3
+    # non-dataclass payloads pass through untouched
+    raw = object()
+    assert adv._flip_payload(raw) is raw
+
+
+def test_flip_bool_adversary_end_to_end():
+    net = TestNetwork(6, 1, _ba, adversary=FlipBoolAdversary(), seed=9)
+    net.input_all(False)
+    outs = net.run()
+    assert len(outs) == 6
+    assert {tuple(v) for v in outs.values()} == {(False,)}
