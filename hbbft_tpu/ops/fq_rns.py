@@ -286,6 +286,17 @@ def select(cond: jnp.ndarray, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     return jnp.where(cond[..., None], a, b)
 
 
+#: Extension-matmul strategy (HBBFT_TPU_RNS_EXT): ``highest`` —
+#: precision=HIGHEST f32 einsums (exact; TPU lowers each to 6 bf16 MXU
+#: passes); ``bf16`` — explicit 6/5-bit plane split so operands ARE
+#: bf16-exact, 4 native passes; ``int8`` — same split on the int8 MXU
+#: path (int32 accumulation).  All three are bit-identical (every
+#: partial bound derived below); the window A/B picks the on-chip
+#: default.  Read at import (kernels cache jitted closures).
+_EXT_MODE = os.environ.get("HBBFT_TPU_RNS_EXT", "highest")
+assert _EXT_MODE in ("highest", "bf16", "int8"), _EXT_MODE
+
+
 def _ext_matmul(sigma: jnp.ndarray, lo: jnp.ndarray, hi: jnp.ndarray,
                 p_out, invp_out) -> jnp.ndarray:
     """Σ_i sigma_i · E[i, j] mod p_j via the entry-split constant matmuls.
@@ -294,21 +305,54 @@ def _ext_matmul(sigma: jnp.ndarray, lo: jnp.ndarray, hi: jnp.ndarray,
     below 2^22.3 so f32 accumulation is exact.  The hi partial is reduced
     before recombination so the weighted sum also stays exact.
 
-    precision=HIGHEST is load-bearing: TPU f32 dots default to single
-    bf16 passes, and 11-bit sigma lanes are NOT bf16-exact — the default
-    would silently round operands before multiplying.  HIGHEST selects
-    the exact-f32 algorithm (products ≤ 2^22 and sums < 2^24 are exact).
-    (Perf lever if these tiny matmuls ever show up in a profile: split
-    sigma into 6/5-bit planes like the matrices and run native bf16.)"""
-    hp = jax.lax.Precision.HIGHEST
-    s_lo = jnp.einsum(
-        "...i,ij->...j", sigma, lo, precision=hp, preferred_element_type=DTYPE
+    ``highest`` mode: precision=HIGHEST is load-bearing — TPU f32 dots
+    default to single bf16 passes, and 11-bit sigma lanes are NOT
+    bf16-exact; HIGHEST selects the exact-f32 algorithm.
+
+    ``bf16``/``int8`` modes additionally split sigma into a 6-bit lo /
+    5-bit hi plane (mirroring fq_rns_pallas._split_dot): all four
+    operands are then ≤ 6-bit integers — exactly representable in bf16
+    AND int8 — so the dots run as NATIVE MXU passes (4 instead of
+    HIGHEST's 6 per einsum pair) with exact f32/int32 accumulation:
+
+        ll ≤ 39·63·63 < 2^17.3      lh, hl ≤ 39·31·63 < 2^16.3
+        hh ≤ 39·31·31 < 2^15.3
+        ll + 64·mod(lh+hl) + 4096·mod(hh) ≤ 155k + 131k + 8.39M < 2^24
+    """
+    if _EXT_MODE == "highest":
+        hp = jax.lax.Precision.HIGHEST
+        s_lo = jnp.einsum(
+            "...i,ij->...j", sigma, lo, precision=hp, preferred_element_type=DTYPE
+        )
+        s_hi = jnp.einsum(
+            "...i,ij->...j", sigma, hi, precision=hp, preferred_element_type=DTYPE
+        )
+        s_hi = _mod_lanes(s_hi, p_out, invp_out)
+        return _mod_lanes(s_lo + _SPLIT_SHIFT * s_hi, p_out, invp_out)
+
+    v_hi = jnp.floor(sigma * (1.0 / _SPLIT_SHIFT))
+    v_lo = sigma - _SPLIT_SHIFT * v_hi
+    if _EXT_MODE == "int8":
+        op, acc = jnp.int8, jnp.int32
+    else:
+        op, acc = jnp.bfloat16, DTYPE
+
+    def dot(v, m):
+        return jnp.einsum(
+            "...i,ij->...j",
+            v.astype(op),
+            m.astype(op),
+            preferred_element_type=acc,
+        ).astype(DTYPE)
+
+    ll = dot(v_lo, lo)
+    mid = _mod_lanes(dot(v_hi, lo) + dot(v_lo, hi), p_out, invp_out)
+    hh = _mod_lanes(dot(v_hi, hi), p_out, invp_out)
+    return _mod_lanes(
+        ll + _SPLIT_SHIFT * mid + (_SPLIT_SHIFT * _SPLIT_SHIFT) * hh,
+        p_out,
+        invp_out,
     )
-    s_hi = jnp.einsum(
-        "...i,ij->...j", sigma, hi, precision=hp, preferred_element_type=DTYPE
-    )
-    s_hi = _mod_lanes(s_hi, p_out, invp_out)
-    return _mod_lanes(s_lo + _SPLIT_SHIFT * s_hi, p_out, invp_out)
 
 
 _E1_LO_J = jnp.asarray(_E1_LO)

@@ -125,6 +125,42 @@ def test_exactness_margins():
     assert R.M_R > R.N_B + 2
 
 
+@pytest.mark.parametrize("mode", ["bf16", "int8"])
+def test_ext_matmul_modes_golden(mode):
+    """HBBFT_TPU_RNS_EXT plane-split strategies must be bit-identical to
+    the HIGHEST default (env read at import → subprocess)."""
+    import os
+    import subprocess
+    import sys
+
+    code = """
+import jax; jax.config.update("jax_platforms", "cpu")
+import random
+import numpy as np
+from hbbft_tpu.crypto.field import Q
+from hbbft_tpu.ops import fq_rns as R
+rng = random.Random(13)
+xs = [rng.randrange(Q) for _ in range(6)]
+ys = [rng.randrange(Q) for _ in range(6)]
+a = np.asarray(R.from_ints(xs)); b = np.asarray(R.from_ints(ys))
+got = R.to_ints(np.asarray(R.mul(a, b)))
+assert got == [x * y % Q for x, y in zip(xs, ys)], got
+inv = R.to_int(np.asarray(R.inv(np.asarray(R.from_int(xs[0])))))
+assert inv == pow(xs[0], -1, Q)
+print("OK", R._EXT_MODE)
+"""
+    env = dict(os.environ)
+    env["HBBFT_TPU_RNS_EXT"] = mode
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    proc = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=600, env=env,
+    )
+    assert proc.returncode == 0, proc.stderr[-800:]
+    assert f"OK {mode}" in proc.stdout
+
+
 def test_facade_subprocess_tower_pairing():
     """HBBFT_TPU_FQ_IMPL=rns swaps the facade: the tower stack must stay
     golden end-to-end (one fq12 mul + a cyclo chain under the flag)."""

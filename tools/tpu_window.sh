@@ -16,6 +16,7 @@
 #   6. full driver bench (fills every remaining row on TPU)
 #   7. RS encode int8-vs-bf16 dot A/B (task 4)
 #   8. per-mul fused RNS A/B (HBBFT_TPU_RNS_FUSED=all vs pow)
+#   9. extension-matmul strategy A/B (HBBFT_TPU_RNS_EXT highest/bf16/int8)
 # Each bench.py run OVERWRITES BENCH_rows.json with its own row set, so
 # a snapshot is copied to tpu_window_r04/ after every step — the
 # archive is the snapshot directory, and a dying tunnel can only lose
@@ -71,5 +72,18 @@ echo "=== $(TS) step 8: per-mul fused RNS A/B on the flagship row ==="
 HBBFT_TPU_FQ_IMPL=rns HBBFT_TPU_RNS_FUSED=all BENCH_ONLY=rlc_dec \
   timeout 1800 python bench.py
 SNAP step8_fused_all
+
+echo "=== $(TS) step 9: extension-matmul strategy A/B (single size) ==="
+# HIGHEST (6 MXU passes) vs explicit bf16 planes (4) vs int8 MXU
+HBBFT_TPU_FQ_IMPL=rns HBBFT_TPU_RNS_EXT=bf16 KB_FUSED=0 KB_NO_ROOFLINE=1 \
+  KB_LANES=65536 timeout 900 python tools/kernel_bench.py 2>&1 \
+  | tee "$ART/kernel_rns_bf16.log"
+HBBFT_TPU_FQ_IMPL=rns HBBFT_TPU_RNS_EXT=int8 KB_FUSED=0 KB_NO_ROOFLINE=1 \
+  KB_LANES=65536 timeout 900 python tools/kernel_bench.py 2>&1 \
+  | tee "$ART/kernel_rns_int8.log"
+# if either wins on the rlc_dec graph too, promote via env default:
+HBBFT_TPU_FQ_IMPL=rns HBBFT_TPU_RNS_EXT=int8 BENCH_ONLY=rlc_dec \
+  timeout 1200 python bench.py
+SNAP step9_ext_ab
 
 echo "=== $(TS) done — snapshots in $ART/ ==="
