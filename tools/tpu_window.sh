@@ -27,28 +27,53 @@ TS() { date -u +%H:%M:%S; }
 ART=tpu_window_r04
 mkdir -p "$ART"
 SNAP() { cp -f BENCH_rows.json "$ART/rows_after_$1.json" 2>/dev/null || true; }
+# Abort between steps when the tunnel has died: the remaining steps
+# would silently run (and record) CPU fallback instead, overwriting
+# BENCH_rows.json with cpu rows and burning the wall clock.  A FRESH
+# watcher flag (<400s, the bench.py staleness bound) short-circuits;
+# otherwise — flag missing (watcher restarting?) or stale (watcher
+# dead?) — one direct probe decides, so neither case misfires.
+ALIVE() {
+  if [ -f /tmp/tpu_alive ]; then
+    age=$(( $(date +%s) - $(stat -c %Y /tmp/tpu_alive 2>/dev/null || echo 0) ))
+    [ "$age" -lt 400 ] && return 0
+  fi
+  out=$(timeout 120 python -c "
+import jax, numpy as np, jax.numpy as jnp
+v = float(np.asarray(jnp.ones((8,8)) @ jnp.ones((8,8)))[0][0])
+assert jax.devices()[0].platform in ('tpu', 'axon')
+print('OK')" 2>/dev/null | grep -c '^OK')
+  if [ "$out" != "1" ]; then
+    echo "=== $(TS) tunnel died — aborting window capture ==="
+    exit 1
+  fi
+}
 
 echo "=== $(TS) step 1: kernel A/B limb vs rns (+fused-chain probe) ==="
 timeout 1200 python tools/kernel_bench.py 2>&1 | tee "$ART/kernel_limb.log"
 HBBFT_TPU_FQ_IMPL=rns timeout 1800 python tools/kernel_bench.py 2>&1 \
   | tee "$ART/kernel_rns.log"
 
+ALIVE
 echo "=== $(TS) step 2: flagship rows + n16 real-crypto under rns ==="
 HBBFT_TPU_FQ_IMPL=rns \
   BENCH_ONLY=rlc_dec,rlc_sig,coin_e2e,g2_sign,share_verify,rlc_dec_adversarial,array_n16_tpu \
   timeout 3600 python bench.py
 SNAP step2_rns
 
+ALIVE
 echo "=== $(TS) step 3: rlc_dec + coin under limb (graph A/B) ==="
 BENCH_ONLY=rlc_dec,coin_e2e timeout 1800 python bench.py
 SNAP step3_limb
 
+ALIVE
 echo "=== $(TS) step 4: N=100 real-crypto epochs + era change ==="
 HBBFT_TPU_FQ_IMPL=rns BENCH_ONLY=array_n100 BENCH_ARRAY_BACKEND=tpu \
   BENCH_ARRAY_EPOCHS=10 BENCH_ARRAY_CHURN=1 \
   timeout 5400 python bench.py
 SNAP step4_n100
 
+ALIVE
 echo "=== $(TS) step 5: config 2 at size (10k flips; n64 coin macro) ==="
 HBBFT_TPU_FQ_IMPL=rns BENCH_ONLY=coin_e2e BENCH_COIN_FLIPS=10000 \
   timeout 3600 python bench.py
@@ -57,10 +82,12 @@ HBBFT_TPU_FQ_IMPL=rns BENCH_ONLY=array_n64_coin BENCH_COIN_MACRO_BACKEND=tpu \
   timeout 1800 python bench.py
 SNAP step5_macro
 
+ALIVE
 echo "=== $(TS) step 6: full driver bench (tpu; fq A/B inside) ==="
 HBBFT_TPU_FQ_IMPL=rns timeout 5400 python bench.py
 cp -f BENCH_rows.json "$ART/rows_full_rns.json" 2>/dev/null || true
 
+ALIVE
 echo "=== $(TS) step 7: RS encode (int8 vs bf16 dot A/B) ==="
 BENCH_ONLY=rs_encode timeout 900 python bench.py
 BENCH_ONLY=rs_encode HBBFT_TPU_GF_DOT=bf16 timeout 900 python bench.py
@@ -68,11 +95,13 @@ BENCH_ONLY=rs_encode HBBFT_TPU_GF_DOT=bf16 BENCH_RS_SHARD=65536 \
   timeout 900 python bench.py
 SNAP step7_rs
 
+ALIVE
 echo "=== $(TS) step 8: per-mul fused RNS A/B on the flagship row ==="
 HBBFT_TPU_FQ_IMPL=rns HBBFT_TPU_RNS_FUSED=all BENCH_ONLY=rlc_dec \
   timeout 1800 python bench.py
 SNAP step8_fused_all
 
+ALIVE
 echo "=== $(TS) step 9: extension-matmul strategy A/B (single size) ==="
 # HIGHEST (6 MXU passes) vs explicit bf16 planes (4) vs int8 MXU
 HBBFT_TPU_FQ_IMPL=rns HBBFT_TPU_RNS_EXT=bf16 KB_FUSED=0 KB_NO_ROOFLINE=1 \
