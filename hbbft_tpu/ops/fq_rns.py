@@ -407,8 +407,15 @@ def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
         from hbbft_tpu.ops import fq_rns_pallas
 
         return fq_rns_pallas.mul(a, b)
-    a = carry3(a)
-    b = carry3(b)
+    return _mul_body(carry3(a), carry3(b))
+
+
+def _mul_body(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """mul minus the input renormalization: requires BOTH operands'
+    lanes already in (−p, 2p) — true for carry3 output and for any
+    output of this function itself (so chains may skip the re-carry:
+    |a·b| ≤ 4p² < 2^24 is the import-asserted envelope; the same
+    steady-state argument as fq_rns_pallas._mul_core(reduced=True))."""
     # sign offset (multiple of Q) keeps the reduced integer non-negative;
     # x lanes stay UNREDUCED in (−p, 3p): both downstream products still
     # fit the exact envelope (3p·p ≈ 2^23.6 < 2^24, ~25% headroom — any
@@ -478,18 +485,24 @@ def mul_small(a: jnp.ndarray, k: int) -> jnp.ndarray:
 
 
 def pow_fixed(x: jnp.ndarray, exponent: int) -> jnp.ndarray:
-    """x^exponent (Montgomery chain; exponent baked into the graph)."""
+    """x^exponent (Montgomery chain; exponent baked into the graph).
+
+    The base is carried ONCE outside the scan and the body chains
+    :func:`_mul_body` directly — every operand inside the loop is a mul
+    output (lanes in (−p, 2p)), so the per-iteration re-carry the naive
+    form pays (4 of ~15 reduction stages per mul) is skipped."""
     if exponent >= 1 and _use_fused("pow"):
         from hbbft_tpu.ops import fq_rns_pallas
 
         return fq_rns_pallas.pow_fixed(x, exponent)
     bits = [int(b) for b in bin(exponent)[2:]]
     bits_arr = jnp.asarray(bits, dtype=jnp.int32)
+    x_c = carry3(x)
 
     def step(acc, bit):
-        acc = sqr(acc)
+        acc = _mul_body(acc, acc)
         cond = jnp.broadcast_to(bit.astype(bool), acc.shape[:-1])
-        acc = select(cond, mul(acc, x), acc)
+        acc = select(cond, _mul_body(acc, x_c), acc)
         return acc, None
 
     ones = jnp.broadcast_to(jnp.asarray(ONE), x.shape)
@@ -502,13 +515,19 @@ def inv(x: jnp.ndarray) -> jnp.ndarray:
 
 
 def batch_inv(x: jnp.ndarray) -> jnp.ndarray:
-    prefix = jax.lax.associative_scan(mul, x, axis=0)
-    suffix = jax.lax.associative_scan(mul, x, axis=0, reverse=True)
+    # carry the whole stack ONCE: associative_scan passes the endpoint
+    # elements through RAW (prefix[0] = x[0]), so scanning over lazy
+    # lanes would violate _mul_body's (−p, 2p) operand contract at the
+    # wings.  Post-carry, every scan leaf/combination and the wing
+    # products below are in-contract, so all re-carries are skipped.
+    xc = carry3(x)
+    prefix = jax.lax.associative_scan(_mul_body, xc, axis=0)
+    suffix = jax.lax.associative_scan(_mul_body, xc, axis=0, reverse=True)
     tinv = inv(prefix[-1])
     one = jnp.broadcast_to(jnp.asarray(ONE), x[:1].shape)
     pre = jnp.concatenate([one, prefix[:-1]], axis=0)
     suf = jnp.concatenate([suffix[1:], one], axis=0)
-    return mul(mul(pre, suf), jnp.broadcast_to(tinv, x.shape))
+    return _mul_body(_mul_body(pre, suf), jnp.broadcast_to(tinv, x.shape))
 
 
 def is_zero_host(res) -> bool:

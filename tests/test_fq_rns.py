@@ -108,6 +108,21 @@ def test_select_and_zero(rng):
     assert not R.is_zero_host(np.asarray(R.ONE))
 
 
+def test_batch_inv_lazy_endpoints(rng):
+    """batch_inv over LAZY stacks: associative_scan passes endpoint
+    elements through raw, so lanes grown by chained adds (legal under
+    the value discipline) must be carried before the no-recarry scan —
+    regression for the (−p, 2p) contract violation at the wings."""
+    xs = [rng.randrange(1, Q) for _ in range(4)]
+    base = R.from_ints(xs)
+    lazy = base
+    for _ in range(5):  # lanes up to ~32·p — far outside (−p, 2p)
+        lazy = R.add(lazy, lazy)
+    vals = [(x << 5) % Q for x in xs]
+    got = R.to_ints(np.asarray(R.batch_inv(jnp.asarray(lazy))))
+    assert got == [pow(v, -1, Q) for v in vals]
+
+
 def test_exactness_margins():
     """Every f32 intermediate bound the module relies on, re-derived."""
     # extension partial sums: 39 terms of (p-1)*63 / (p-1)*31
