@@ -1088,11 +1088,9 @@ def _reexec_on_cpu(reason: str) -> None:
     env["JAX_PLATFORMS"] = "cpu"
     env["BENCH_PLATFORM_CHECKED"] = "1"
     env["BENCH_CPU_FALLBACK"] = "1"  # marks rows/shapes as degraded-mode
-    # On XLA:CPU the RNS field is ~10x the limb path on the raw kernel
-    # (PERF.md "Round 3: RNS" A/B, 2.5 vs 0.25 M muls/s) and 20-30x on
-    # the full verification graphs — degraded runs default to it.  The
-    # TPU path keeps the limb default until the on-chip A/B
-    # (tools/tpu_window.sh) settles promotion.
+    # RNS is the global default since the round-4 on-chip A/B settled
+    # promotion (rlc_dec 6.0x, CPU kernel 16.7x); the setdefault is kept
+    # so degraded re-exec preserves an explicit caller override.
     env.setdefault("HBBFT_TPU_FQ_IMPL", "rns")
     os.execve(sys.executable, [sys.executable] + sys.argv, env)
 
@@ -1211,7 +1209,7 @@ class _RowSink:
         self.meta = {
             "platform": platform,
             "cpu_fallback": bool(os.environ.get("BENCH_CPU_FALLBACK")),
-            "fq_impl": os.environ.get("HBBFT_TPU_FQ_IMPL", "limb"),
+            "fq_impl": os.environ.get("HBBFT_TPU_FQ_IMPL", "rns"),
             "started_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
             "bench_only": os.environ.get("BENCH_ONLY") or None,
         }
@@ -1344,7 +1342,7 @@ def main() -> None:
         try:
             row = _with_fallback(fn)
             row["platform"] = platform
-            fq_impl = os.environ.get("HBBFT_TPU_FQ_IMPL", "limb")
+            fq_impl = os.environ.get("HBBFT_TPU_FQ_IMPL", "rns")
             # label only rows whose bench executes the Fq facade (mock
             # macros and the GF(2^8) RS row never touch field code)
             backend_name = str(row.get("backend", ""))

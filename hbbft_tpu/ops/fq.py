@@ -384,15 +384,18 @@ def is_zero_host(limbs) -> bool:
 
 # -- implementation facade ----------------------------------------------------
 #
-# HBBFT_TPU_FQ_IMPL=rns swaps the whole public surface for the RNS /
-# MXU-matmul implementation (ops/fq_rns.py): same API, same semantics
-# (values mod Q through from_int/to_int), different device layout —
-# (..., 79) residue lanes instead of (..., 50) limbs.  Everything above
-# the Fq API (tower, curve, pairing, backend) is representation-agnostic
-# and picks the binding up at import.  The limb internals (reduce_conv,
-# BITS/CONV/_FOLD_ROWS, the Pallas kernels) stay limb-only: under RNS the
-# rebound `mul` never routes through them.
-_FQ_IMPL = os.environ.get("HBBFT_TPU_FQ_IMPL", "limb")
+# HBBFT_TPU_FQ_IMPL selects the field implementation.  Default is the
+# RNS / MXU-matmul implementation (ops/fq_rns.py) — promoted round 4 on
+# the measured on-chip A/B (rlc_dec 16.8k vs 2.8k shares/s, 6.0×; CPU
+# kernel A/B 16.7×; tpu_window_r04/).  HBBFT_TPU_FQ_IMPL=limb keeps the
+# limb path as an independent golden cross-check and legacy A/B arm.
+# Same API, same semantics (values mod Q through from_int/to_int),
+# different device layout — (..., 79) residue lanes vs (..., 50) limbs.
+# Everything above the Fq API (tower, curve, pairing, backend) is
+# representation-agnostic and picks the binding up at import.  The limb
+# internals (reduce_conv, BITS/CONV/_FOLD_ROWS, the Pallas kernels) stay
+# limb-only: under RNS the rebound `mul` never routes through them.
+_FQ_IMPL = os.environ.get("HBBFT_TPU_FQ_IMPL", "rns")
 if _FQ_IMPL == "rns":
     from hbbft_tpu.ops import fq_rns as _rns
 

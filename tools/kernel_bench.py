@@ -69,7 +69,7 @@ def measure_mul(rng, lanes, reps=2):
 
 def main():
     rng = np.random.default_rng(0)
-    impl = os.environ.get("HBBFT_TPU_FQ_IMPL", "limb")
+    impl = os.environ.get("HBBFT_TPU_FQ_IMPL", "rns")
     limb_only = (
         f" BITS={fq.BITS}"
         f" conv_mode={os.environ.get('HBBFT_TPU_CONV_MODE', 'scratch')}"
