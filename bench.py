@@ -4,6 +4,11 @@ Rows print in SUPPORT-FIRST order (flagship crypto rows and macro rows
 last, north-star ``array_epochs_per_sec_n100`` as the final line) because
 the driver records a stdout tail; the FULL row set is also written to
 ``BENCH_rows.json`` after every row so truncation can't lose evidence.
+Under a TIME BUDGET (``BENCH_BUDGET`` seconds; defaulted to 3000 for
+driver-style full runs on real TPU) the order flips to FLAGSHIP-FIRST
+and benches that no longer fit are skipped with labeled rows — round 4's
+driver run was timeout-killed before the support-first ordering reached
+a single flagship row (verdict Weak #3).
 
 Flagship micro-metric: ``rlc_dec_verify_throughput`` —
 **threshold-decrypt shares verified/sec/chip**, BASELINE.json's operative
@@ -826,7 +831,17 @@ def _bench_array_engine(
     net.run_epochs(1, payload_size=64)  # warm: compile/caches
     counters = getattr(backend, "counters", None)
     ctr0 = counters.snapshot() if counters is not None else {}
-    churn_ctr = {"device_seconds": 0.0, "hash_g2_seconds": 0.0}
+    churn_ctr = {
+        "device_seconds": 0.0,
+        "hash_g2_seconds": 0.0,
+        # per-kind split (r4 verdict task 7): rows elide zero-valued kinds
+        "device_seconds_pairing": 0.0,
+        "device_seconds_rlc_sig": 0.0,
+        "device_seconds_rlc_dec": 0.0,
+        "device_seconds_combine": 0.0,
+        "device_seconds_sign": 0.0,
+        "device_seconds_decrypt": 0.0,
+    }
     # mid-run only: era changes need a preceding and a following epoch, so
     # indices clamp to [1, epochs-1] and dedupe (epochs < 2 → no churn; the
     # row's churn_epochs field reports what actually ran).
@@ -1202,7 +1217,10 @@ class _RowSink:
     so far) and is self-describing: platform, fallback mode, fq impl,
     and a wall-clock stamp per run."""
 
-    PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_rows.json")
+    PATH = os.environ.get(
+        "BENCH_ROWS_PATH",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_rows.json"),
+    )
 
     def __init__(self, platform: str) -> None:
         self.rows = []
@@ -1225,46 +1243,137 @@ class _RowSink:
             pass  # a read-only checkout must not kill the bench
 
 
+def bench_array_engine_n100_tpu() -> dict:
+    """The NORTH STAR at its defined shape inside a DRIVER run: N=100 f=33
+    real-crypto (TpuBackend) epochs + one era change.  The window runbook
+    runs the same shape via BENCH_ONLY=array_n100 + env; this entry exists
+    so the driver-visible artifact itself carries a real-crypto N=100 row
+    (round-4 verdict Missing #5 / task 8) — epochs default small (3) so the
+    row lands inside the driver's timeout; BENCH_N100_TPU_EPOCHS raises it.
+    Skipped off-TPU (XLA:CPU measured ~55 min/epoch at N=64)."""
+    overrides = {
+        "BENCH_ARRAY_BACKEND": "tpu",
+        "BENCH_ARRAY_EPOCHS": os.environ.get("BENCH_N100_TPU_EPOCHS", "3"),
+        "BENCH_ARRAY_CHURN": os.environ.get("BENCH_N100_TPU_CHURN", "1"),
+    }
+    saved = {k: os.environ.get(k) for k in overrides}
+    os.environ.update(overrides)
+    try:
+        return bench_array_engine_n100()
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+# Rough per-bench wall-cost estimates on TPU, seconds (measured: round-4
+# window logs — step 2's seven rows took ~17 min incl. compiles; n100
+# real-crypto per-epoch from the round-5 step-4 capture).  Used only by
+# budget mode to decide what still fits; deliberately pessimistic.
+_BENCH_EST_S = {
+    "rlc_dec": 180, "share_verify": 150, "rlc_sig": 150, "g2_sign": 150,
+    "coin_e2e": 240, "rlc_dec_adversarial": 150, "array_n16_tpu": 420,
+    "array_n100_tpu": 2400, "rs_encode": 120, "rs_host": 60,
+    "fq_kernel": 240, "n4": 60, "n4_realcrypto": 300, "n100": 420,
+    "array_n256_soak": 300, "array_n100_dedup": 120, "array_n64_coin": 240,
+    "array_n100": 300,
+}
+
+
+def _plan_benches(only, platform: str, budget: float) -> list:
+    """Ordered (name, fn) bench list.
+
+    No budget → SUPPORT-FIRST order: the driver records a tail of stdout,
+    which in round 3 truncated the flagship crypto rows out of
+    BENCH_r03.json (verdict Weak #1); flagship rows print last.
+
+    Budget set → FLAGSHIP-FIRST order: round 4's driver run hit its
+    timeout (rc=124) before the support-first ordering reached the
+    flagship rows, so the artifact carried none of them (verdict Weak #3).
+    Under a budget the valuable rows run FIRST (BENCH_rows.json preserves
+    them whatever happens to stdout), and the runner skips any later bench
+    whose cost estimate no longer fits.
+    """
+    arrays = os.environ.get("BENCH_ARRAY", "1") != "0"
+    n4 = os.environ.get("BENCH_N4", "1") != "0"
+    n100 = os.environ.get("BENCH_N100", "1") != "0"
+    soak = os.environ.get("BENCH_SOAK", "1") != "0"
+    fqk = os.environ.get("BENCH_FQ", "1") != "0"
+
+    if budget:
+        plan = [
+            ("rlc_dec", bench_rlc_dec),
+            ("share_verify", bench_share_verify),
+            ("rlc_sig", bench_rlc_sig),
+            ("g2_sign", bench_g2_sign),
+            ("coin_e2e", bench_coin_e2e),
+            ("rlc_dec_adversarial", bench_rlc_dec_adversarial),
+        ]
+        if arrays:
+            plan.append(("array_n16_tpu", bench_array_engine_n16_tpu))
+            if platform == "tpu":
+                plan.append(("array_n100_tpu", bench_array_engine_n100_tpu))
+        plan += [("rs_encode", bench_rs_encode), ("rs_host", bench_rs_host)]
+        if fqk:
+            plan.append(("fq_kernel", bench_fq_kernel))
+        if n4:
+            plan.append(("n4", bench_epochs_n4))
+            plan.append(("n4_realcrypto", bench_epochs_n4_realcrypto))
+        if n100:
+            plan.append(("n100", bench_epochs_n100))
+        if soak:
+            plan.append(("array_n256_soak", bench_array_engine_n256_soak))
+        if arrays:
+            plan.append(("array_n100_dedup", bench_array_engine_n100_dedup))
+            plan.append(("array_n64_coin", bench_array_engine_n64_coin))
+            plan.append(("array_n100", bench_array_engine_n100))
+    else:
+        # Legacy support-first print order, identical to rounds 1-4
+        # (flagships last, mock north star at the very end).
+        plan = [
+            ("rs_encode", bench_rs_encode),
+            ("rs_host", bench_rs_host),
+            ("share_verify", bench_share_verify),
+        ]
+        if n4:
+            plan.append(("n4", bench_epochs_n4))
+            plan.append(("n4_realcrypto", bench_epochs_n4_realcrypto))
+        if n100:
+            plan.append(("n100", bench_epochs_n100))
+        if soak:
+            plan.append(("array_n256_soak", bench_array_engine_n256_soak))
+        if arrays:
+            plan.append(("array_n100_dedup", bench_array_engine_n100_dedup))
+        plan += [
+            ("rlc_sig", bench_rlc_sig),
+            ("g2_sign", bench_g2_sign),
+            ("coin_e2e", bench_coin_e2e),
+            ("rlc_dec_adversarial", bench_rlc_dec_adversarial),
+        ]
+        if fqk:
+            plan.append(("fq_kernel", bench_fq_kernel))
+        plan.append(("rlc_dec", bench_rlc_dec))
+        if arrays:
+            plan.append(("array_n16_tpu", bench_array_engine_n16_tpu))
+            plan.append(("array_n64_coin", bench_array_engine_n64_coin))
+            plan.append(("array_n100", bench_array_engine_n100))
+        # array_n100_tpu is reachable without a budget only by request
+        plan.append(("array_n100_tpu", bench_array_engine_n100_tpu))
+    if only is not None:
+        plan = [(n, f) for (n, f) in plan if n in only]
+    else:
+        plan = [(n, f) for (n, f) in plan if n != "array_n100_tpu" or budget]
+    return plan
+
+
 def main() -> None:
     _ensure_live_accelerator()
     if os.environ.get("BENCH_ONLY"):
         only = set(os.environ["BENCH_ONLY"].split(","))
     else:
         only = None
-    # Ordered so the FLAGSHIP rows print LAST: the driver records a tail
-    # of stdout, which in round 3 truncated the crypto rows (the round's
-    # whole story) out of BENCH_r03.json (verdict Weak #1).  Support rows
-    # first, then the crypto micro-rows, then the macro rows; the very
-    # last line stays the north-star array_epochs_per_sec_n100.  The full
-    # row set is ALSO written to BENCH_rows.json (see _RowSink) so no
-    # stdout truncation can lose evidence again.
-    benches = [
-        ("rs_encode", bench_rs_encode),
-        ("rs_host", bench_rs_host),
-        ("share_verify", bench_share_verify),
-    ]
-    if os.environ.get("BENCH_N4", "1") != "0":
-        benches.append(("n4", bench_epochs_n4))
-        benches.append(("n4_realcrypto", bench_epochs_n4_realcrypto))
-    if os.environ.get("BENCH_N100", "1") != "0":
-        benches.append(("n100", bench_epochs_n100))
-    if os.environ.get("BENCH_SOAK", "1") != "0":
-        benches.append(("array_n256_soak", bench_array_engine_n256_soak))
-    if os.environ.get("BENCH_ARRAY", "1") != "0":
-        benches.append(("array_n100_dedup", bench_array_engine_n100_dedup))
-    benches += [
-        ("rlc_sig", bench_rlc_sig),
-        ("g2_sign", bench_g2_sign),
-        ("coin_e2e", bench_coin_e2e),
-        ("rlc_dec_adversarial", bench_rlc_dec_adversarial),
-    ]
-    if os.environ.get("BENCH_FQ", "1") != "0":
-        benches.append(("fq_kernel", bench_fq_kernel))
-    benches.append(("rlc_dec", bench_rlc_dec))
-    if os.environ.get("BENCH_ARRAY", "1") != "0":
-        benches.append(("array_n16_tpu", bench_array_engine_n16_tpu))
-        benches.append(("array_n64_coin", bench_array_engine_n64_coin))
-        benches.append(("array_n100", bench_array_engine_n100))
 
     from hbbft_tpu.utils.jax_config import enable_compile_cache, raise_stack_limit
 
@@ -1275,7 +1384,22 @@ def main() -> None:
 
     platform = jax.default_backend()
     cpu_fallback = bool(os.environ.get("BENCH_CPU_FALLBACK"))
+    # Time budget (verdict r4 Weak #3): BENCH_BUDGET=<seconds> switches to
+    # flagship-first ordering and skips benches that no longer fit.  A
+    # driver-style run (full row set on real TPU, no BENCH_ONLY) gets a
+    # DEFAULT budget — round 4's driver bench was rc=124-killed with zero
+    # flagship rows in the artifact; never again.  BENCH_BUDGET=0 disables.
+    budget_env = os.environ.get("BENCH_BUDGET")
+    if budget_env is not None:
+        budget = float(budget_env)
+    elif only is None and platform == "tpu" and not cpu_fallback:
+        budget = 3000.0
+    else:
+        budget = 0.0
+    t_start = time.monotonic()
     sink = _RowSink(platform)
+    if budget:
+        sink.meta["budget_seconds"] = budget
     if os.environ.get("BENCH_ARRAY_DEDUP"):
         sink.emit(
             {
@@ -1314,8 +1438,38 @@ def main() -> None:
             ("BENCH_FQ_CHAIN", "50"),
         ):
             os.environ.setdefault(var, val)
-    for name, fn in benches:
-        if only is not None and name not in only:
+    for name, fn in _plan_benches(only, platform, budget):
+        elapsed = time.monotonic() - t_start
+        if budget and name == "array_n100_tpu":
+            # Adaptive epoch count instead of the generic estimate skip:
+            # fill ~70% of what's left (compile + warm epoch eat the
+            # rest), floor 1, cap at the env/default.  Per-epoch cost
+            # from the round-5 step-4 on-chip capture (_BENCH_EST_S).
+            per_epoch = float(os.environ.get("BENCH_N100_TPU_EPOCH_EST", "450"))
+            fit = int((budget - elapsed) * 0.7 / per_epoch)
+            if fit < 1:
+                sink.emit(
+                    {
+                        "metric": name,
+                        "skipped": "budget exhausted "
+                        f"({elapsed:.0f}s elapsed of {budget:.0f}s; "
+                        f"needs ~{per_epoch * 1.5:.0f}s for one epoch)",
+                        "platform": platform,
+                    }
+                )
+                continue
+            want = _env_int("BENCH_N100_TPU_EPOCHS", 3)
+            os.environ["BENCH_N100_TPU_EPOCHS"] = str(max(1, min(want, fit)))
+        elif budget and elapsed + _BENCH_EST_S.get(name, 120) > budget:
+            sink.emit(
+                {
+                    "metric": name,
+                    "skipped": "budget exhausted "
+                    f"({elapsed:.0f}s elapsed of {budget:.0f}s; "
+                    f"estimate {_BENCH_EST_S.get(name, 120)}s)",
+                    "platform": platform,
+                }
+            )
             continue
         if (
             name == "array_n16_tpu"
@@ -1340,18 +1494,22 @@ def main() -> None:
             )
             continue
         try:
+            t_row = time.monotonic()
             row = _with_fallback(fn)
+            row["row_seconds"] = round(time.monotonic() - t_row, 1)
             row["platform"] = platform
             fq_impl = os.environ.get("HBBFT_TPU_FQ_IMPL", "rns")
-            # label only rows whose bench executes the Fq facade (mock
-            # macros and the GF(2^8) RS row never touch field code)
+            # label every row whose bench executes the Fq facade (mock
+            # macros and the GF(2^8) RS row never touch field code) —
+            # including the limb arm, so A/B artifacts are per-row
+            # self-describing (ADVICE r4 low #3)
             backend_name = str(row.get("backend", ""))
             uses_fq = (
                 name in _FQ_ROWS
                 or backend_name == "TpuBackend"
                 or backend_name.startswith("MeshBackend")
             )
-            if fq_impl != "limb" and uses_fq:
+            if uses_fq:
                 row["fq_impl"] = fq_impl
             if backend_name == "MockBackend" and "vs_baseline" in row:
                 # the estimated baselines are real-crypto cost models; a

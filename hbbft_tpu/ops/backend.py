@@ -224,17 +224,25 @@ class TpuBackend(CryptoBackend):
         )
         Q2 = pairing.g2_affine_to_device([q[3] for q in quads])
 
-        f = self._dispatch_fetch(_jitted_product2(), self._place((P1, Q1, P2, Q2)))
+        f = self._dispatch_fetch(
+            _jitted_product2(), self._place((P1, Q1, P2, Q2)), kind="pairing"
+        )
         return [pairing.is_one_host(f, i) for i in range(n)]
 
-    def _dispatch_fetch(self, jitted, args):
+    def _dispatch_fetch(self, jitted, args, kind: str = ""):
         """Dispatch one jitted call and fetch the result to host, billing
         the wall clock to counters.device_seconds (task-8 attribution —
-        includes any queued device work this fetch must wait for)."""
+        includes any queued device work this fetch must wait for) and,
+        when ``kind`` is given, to ``device_seconds_<kind>`` so macro rows
+        can break an epoch's device time down by op kind (r4 task 7)."""
         t0 = time.perf_counter()
         out = jitted(*args)
         out = jax.tree_util.tree_map(np.asarray, out)
-        self.counters.device_seconds += time.perf_counter() - t0
+        dt = time.perf_counter() - t0
+        self.counters.device_seconds += dt
+        if kind:
+            name = "device_seconds_" + kind
+            setattr(self.counters, name, getattr(self.counters, name) + dt)
         return out
 
     # -- grouped (random-linear-combination) verification --------------------
@@ -287,6 +295,7 @@ class TpuBackend(CryptoBackend):
         jitted,
         results: List,
         direct_quad,
+        kind: str = "",
     ) -> None:
         """Run RLC group checks; write per-item booleans into `results`.
 
@@ -332,7 +341,7 @@ class TpuBackend(CryptoBackend):
             self.counters.device_dispatches += 1
             args = build_group_arrays(padded, g, k)
             placed = self._place(tuple(args) + (jnp.asarray(rbits),))
-            f = self._dispatch_fetch(jitted, placed)
+            f = self._dispatch_fetch(jitted, placed, kind=kind)
             next_pending: List[List[int]] = []
             for gi, grp in enumerate(pending):
                 if pairing.is_one_host(f, gi):
@@ -414,7 +423,9 @@ class TpuBackend(CryptoBackend):
         def jitted(S_jac, PK_jac, neg_g1, H, rbits):
             return _jitted_rlc_sig()(S_jac, PK_jac, rbits, neg_g1, H)
 
-        self._grouped_rlc(rlc_groups, items, build, jitted, results, direct)
+        self._grouped_rlc(
+            rlc_groups, items, build, jitted, results, direct, kind="rlc_sig"
+        )
         return [bool(r) for r in results]
 
     def verify_signatures(
@@ -487,7 +498,9 @@ class TpuBackend(CryptoBackend):
         def jitted(D_jac, PK_jac, H, W, rbits):
             return _jitted_rlc_dec()(D_jac, PK_jac, rbits, H, W)
 
-        self._grouped_rlc(rlc_groups, items, build, jitted, results, direct)
+        self._grouped_rlc(
+            rlc_groups, items, build, jitted, results, direct, kind="rlc_dec"
+        )
         return [bool(r) for r in results]
 
     def verify_ciphertexts(self, items: Sequence[Ciphertext]) -> List[bool]:
@@ -517,7 +530,9 @@ class TpuBackend(CryptoBackend):
             [s for s, _ in safe] + [0] * (b - len(pts))
         )
         negs = np.array([n for _, n in safe] + [False] * (b - len(pts)))
-        combined = self._dispatch_fetch(jitted, (to_device(points), bits, negs))
+        combined = self._dispatch_fetch(
+            jitted, (to_device(points), bits, negs), kind="combine"
+        )
         return from_device(combined)[0]
 
     def _lagrange_device_g2(self, pts: List[Tuple[int, Any]]):
@@ -629,7 +644,7 @@ class TpuBackend(CryptoBackend):
             out[idx] = self._plaintext_from_combined(el, items[idx][1])
 
     def _ladder_batch(self, scalars, points, host_fn, chunk_self, to_device,
-                      from_device, jitted):
+                      from_device, jitted, kind=""):
         """Shared body of the batched independent-ladder dispatches
         (decrypt-share generation in G1, coin-share signing in G2):
         threshold gate → lane-capped chunk recursion → bucket pad →
@@ -657,7 +672,8 @@ class TpuBackend(CryptoBackend):
         P = to_device(pts)
         self.counters.device_dispatches += 1
         out = self._dispatch_fetch(
-            jitted, self._place((P, jnp.asarray(bits), jnp.asarray(negs)))
+            jitted, self._place((P, jnp.asarray(bits), jnp.asarray(negs))),
+            kind=kind,
         )
         # from_device's per-lane host affine conversion runs on fetched
         # numpy arrays — host work, deliberately NOT billed as device
@@ -680,6 +696,7 @@ class TpuBackend(CryptoBackend):
             curve.g2_to_device,
             curve.g2_from_device,
             _jitted_g2_mul_batch(),
+            kind="sign",
         )
         return [
             el if isinstance(el, SignatureShare) else SignatureShare(self.group, el)
@@ -773,7 +790,9 @@ class TpuBackend(CryptoBackend):
         bits = jnp.asarray(np.stack(bits_rows))
         negs = jnp.asarray(np.array(negs_rows))
         self.counters.device_dispatches += 1
-        return self._dispatch_fetch(jitted, self._place((P, bits, negs)))
+        return self._dispatch_fetch(
+            jitted, self._place((P, bits, negs)), kind="combine"
+        )
 
     def _combine_sig_chunk(self, pk_set, items, idxs, k, out) -> None:
         combined = self._lagrange_chunk(
@@ -807,6 +826,7 @@ class TpuBackend(CryptoBackend):
             curve.g1_to_device,
             curve.g1_from_device,
             _jitted_g1_mul_batch(),
+            kind="decrypt",
         )
         return [
             el if isinstance(el, DecryptionShare) else DecryptionShare(self.group, el)

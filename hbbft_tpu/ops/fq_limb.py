@@ -1,0 +1,382 @@
+"""Batched BLS12-381 base-field (Fq) limb arithmetic for TPU.
+
+This is the foundation of the device crypto stack (SURVEY.md §7 "hard parts"
+item 1): 381-bit field elements as limb vectors in one of two switchable
+representations (env ``HBBFT_TPU_FQ_BITS``):
+
+* **8-bit limbs × 50 in float32** (default) — the MXU/VPU-rate path.  All
+  intermediate integers stay below 2^24, so float32 arithmetic is *exact*:
+
+      products  ≤ 257²                  ≈ 2^16.01
+      conv sum  ≤ 50 · 257²             ≈ 2^21.7   < 2^24  ✓
+      fold sum  ≤ 51 · 257 · 255        ≈ 2^21.7   < 2^24  ✓
+
+  Float32 multiply-adds run at full VPU rate (int32 multiplies are
+  emulated multi-op on TPU) and the convolution/fold matmuls are eligible
+  for the MXU — this representation exists purely because of that.
+
+* **11-bit limbs × 37 in int32** — the original conservative path, kept as
+  a second independent implementation for golden cross-checking:
+
+      products  ≤ (2^11+ε)^2            ≈ 2^22
+      conv sum  ≤ 37 · 2^22             ≈ 2^27.3   < 2^31  ✓
+      fold sum  ≤ 38 · 2^11 · 2^11.7    ≈ 2^28     < 2^31  ✓
+
+Representation ("lazy residue"), identical in both widths:
+
+* An element is any limb vector ``l[0..NLIMBS-1]`` whose value
+  Σ l_i·2^(BITS·i) is congruent to the represented element mod Q.  Limbs
+  may be negative (subtraction never borrows; signs ride along) and the
+  value may exceed Q — reduction keeps |value| < 2^(BITS·(FOLD_FROM+2))ish,
+  and every op tolerates inputs with a dozen chained lazy adds; vectors at
+  the full 2^(BITS·NLIMBS) capacity are out of domain.
+* ``carry3`` renormalizes limbs to [-1, BASE+1) in three data-independent
+  vector passes (no sequential scan — carries shrink geometrically).  The
+  TOP limb is never split, so no carry is ever dropped.
+* There is deliberately **no canonical reduction on device**: protocols need
+  booleans and byte-strings only at the host seam, where ``to_int`` does an
+  exact Python-int mod-Q.  This removes every sequential carry chain from
+  the jitted graph (SURVEY.md §7 hard part 6: fixed reduction orders).
+
+Multiplication is convolution expressed as one gather + one small matmul:
+``Bmat[i,k] = b[k-i]`` (NLIMBS×CONV, built with a precomputed index/mask
+pair), then ``c = a @ Bmat`` — XLA turns the batch of these into large
+dot-generals, the MXU/VPU-friendly shape the whole design targets.
+
+Reduction mod Q folds limbs ≥ FOLD_FROM through precomputed rows
+``FOLD[j] = limbs(2^(BITS·(FOLD_FROM+j)) mod Q)`` — again a matmul.  Two
+fold rounds bring any CONV-limb convolution back to the lazy invariant.
+
+Reference analogue: the `ff`/`pairing` crates' 64-bit limb arithmetic under
+`threshold_crypto` (SURVEY.md §2.2) — redesigned for a carry-less SIMD ISA
+instead of scalar add-with-carry.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from hbbft_tpu.crypto.field import Q
+
+BITS = int(os.environ.get("HBBFT_TPU_FQ_BITS", "8"))
+if BITS == 8:
+    NLIMBS = 50  # 50·8 = 400 bits capacity; values stay below 2^396.
+    FOLD_FROM = 48  # 2^(8·48) = 2^384 > Q ≈ 2^381.4
+    DTYPE = jnp.float32
+    NP_DTYPE = np.float32
+elif BITS == 11:
+    NLIMBS = 37  # 37·11 = 407 bits capacity; values stay below 2^394.
+    FOLD_FROM = 35  # 2^(11·35) = 2^385 > Q
+    DTYPE = jnp.int32
+    NP_DTYPE = np.int32
+else:  # pragma: no cover - configuration error
+    raise ValueError(f"HBBFT_TPU_FQ_BITS must be 8 or 11, got {BITS}")
+
+BASE = 1 << BITS
+MASK = BASE - 1
+CONV = 2 * NLIMBS - 1
+_INV_BASE = 1.0 / BASE  # exact power of two
+
+
+def _int_to_limbs(x: int, n: int = NLIMBS) -> np.ndarray:
+    """Canonical little-endian limb decomposition of a non-negative int."""
+    if x < 0:
+        raise ValueError("canonical limbs are non-negative")
+    out = np.zeros(n, dtype=np.int64)
+    for i in range(n):
+        out[i] = x & MASK
+        x >>= BITS
+    if x:
+        raise ValueError("value does not fit limb vector")
+    return out.astype(NP_DTYPE)
+
+
+# -- precomputed constants ---------------------------------------------------
+
+# Gather/mask pair turning b (NLIMBS limbs) into the banded matrix
+# Bmat[i, k] = b[k-i], so that (a @ Bmat)[k] = Σ_i a_i·b_{k-i}.
+_K = np.arange(CONV)[None, :]  # (1, CONV)
+_I = np.arange(NLIMBS)[:, None]  # (NLIMBS, 1)
+_GATHER_IDX = np.clip(_K - _I, 0, NLIMBS - 1).astype(np.int32)
+_GATHER_MASK = ((_K - _I >= 0) & (_K - _I < NLIMBS)).astype(NP_DTYPE)
+
+# FOLD[j] = canonical limbs of (2^(BITS·(FOLD_FROM+j)) mod Q): replaces limb
+# positions ≥ FOLD_FROM by their mod-Q equivalents.
+_FOLD_ROWS = np.stack(
+    [
+        _int_to_limbs(pow(1 << BITS, FOLD_FROM + j, Q))
+        for j in range(CONV - FOLD_FROM)
+    ]
+)  # (CONV - FOLD_FROM, NLIMBS)
+
+Q_LIMBS = _int_to_limbs(Q)
+
+ZERO = np.zeros(NLIMBS, dtype=NP_DTYPE)
+ONE = _int_to_limbs(1)
+
+
+# -- host <-> device conversion ---------------------------------------------
+
+
+def from_int(x: int) -> np.ndarray:
+    """Canonical limb vector for x (reduced mod Q first)."""
+    return _int_to_limbs(x % Q)
+
+
+def from_ints(xs) -> np.ndarray:
+    """Stack of canonical limb vectors, shape (len(xs), NLIMBS).
+
+    Value-deduplicated: whole-network batches replicate the same point
+    coordinates across many lanes (one per receiver), so each distinct
+    value is limb-converted once and fanned out with a numpy take —
+    at N=100 this is the difference between ~10⁴ and ~10⁶ conversions
+    per epoch."""
+    xs = [int(x) for x in xs]
+    uniq: dict = {}
+    rows: List[np.ndarray] = []
+    idx = np.empty(len(xs), dtype=np.int64)
+    for j, x in enumerate(xs):
+        pos = uniq.get(x)
+        if pos is None:
+            pos = uniq[x] = len(rows)
+            rows.append(from_int(x))
+        idx[j] = pos
+    if not rows:
+        return np.zeros((0, NLIMBS), dtype=np.asarray(ZERO).dtype)
+    return np.stack(rows)[idx]
+
+
+def to_int(limbs) -> int:
+    """Exact value of a (possibly lazy/negative) limb vector, mod Q."""
+    arr = np.asarray(limbs)
+    val = 0
+    for i in range(arr.shape[-1] - 1, -1, -1):
+        val = (val << BITS) + int(round(float(arr[..., i])))
+    return val % Q
+
+
+def to_ints(batch) -> list:
+    arr = np.asarray(batch)
+    return [to_int(arr[i]) for i in range(arr.shape[0])]
+
+
+# -- core ops (all jnp, batch-agnostic over leading dims) --------------------
+
+
+def _split(x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(hi, lo) with x = hi·BASE + lo, lo ∈ [0, BASE) — exact both dtypes.
+
+    int32 uses shift/mask (arithmetic shift floors negatives correctly);
+    float32 uses an exact power-of-two scale + floor.  Float inputs must be
+    integer-valued with |x| < 2^24 (all callers guarantee this).
+    """
+    if DTYPE == jnp.int32:
+        return x >> BITS, x & MASK
+    hi = jnp.floor(x * _INV_BASE)
+    return hi, x - hi * BASE
+
+
+def carry3(x: jnp.ndarray) -> jnp.ndarray:
+    """Three vectorized carry passes: limbs land in [-1, BASE+1].
+
+    Works for any limb magnitude up to the dtype's exact-integer envelope
+    (2^30 int32 / 2^24 float32).  The top limb accumulates incoming carries
+    without being split (its magnitude stays tiny because reduced values
+    are far below 2^(BITS·(NLIMBS-1))), so nothing is ever truncated.
+    """
+    x = jnp.asarray(x, DTYPE)
+    for _ in range(3):
+        hi, lo = _split(x)
+        # Keep the top limb whole.
+        lo = lo.at[..., -1].set(x[..., -1])
+        shifted = jnp.concatenate(
+            [jnp.zeros_like(hi[..., :1]), hi[..., :-1]], axis=-1
+        )
+        x = lo + shifted
+    return x
+
+
+def _fold(c: jnp.ndarray, rows: jnp.ndarray) -> jnp.ndarray:
+    """Replace limbs ≥ FOLD_FROM via the precomputed mod-Q rows."""
+    lo = c[..., :FOLD_FROM]
+    hi = c[..., FOLD_FROM:]
+    lo = jnp.concatenate(
+        [lo, jnp.zeros(lo.shape[:-1] + (NLIMBS - FOLD_FROM,), dtype=lo.dtype)],
+        axis=-1,
+    )
+    return lo + jnp.einsum(
+        "...j,jk->...k", hi, rows[: hi.shape[-1]], preferred_element_type=DTYPE
+    )
+
+
+_FOLD_J = jnp.asarray(_FOLD_ROWS)
+
+
+def reduce_conv(c: jnp.ndarray) -> jnp.ndarray:
+    """CONV-limb convolution output → NLIMBS-limb lazy residue."""
+    c = carry3(c)  # limbs ≤ BASE+1
+    c = _fold(c, _FOLD_J)  # CONV → NLIMBS limbs
+    c = carry3(c)
+    c = _fold(c, _FOLD_J)  # tidy limbs ≥ FOLD_FROM
+    return carry3(c)
+
+
+def add(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Lazy add — no carry (mul/carry3 downstream absorbs growth)."""
+    return a + b
+
+
+def sub(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Lazy subtract — limbs may go negative; that's fine."""
+    return a - b
+
+
+def neg(a: jnp.ndarray) -> jnp.ndarray:
+    return -a
+
+
+def _use_pallas() -> bool:
+    """Route muls through the fused Pallas kernel on TPU (trace-time check).
+
+    The XLA path materializes the banded matrix in HBM; on TPU the Pallas
+    kernel keeps conv+carry+fold in VMEM.  Disable with
+    HBBFT_TPU_NO_PALLAS=1.
+    """
+    if os.environ.get("HBBFT_TPU_NO_PALLAS"):
+        return False
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Full product + reduction.  Inputs may be lazy (limbs grown by a few
+    chained adds); they are renormalized before the convolution."""
+    if _use_pallas():
+        from hbbft_tpu.ops import fq_pallas
+
+        return fq_pallas.mul(a, b)
+    a = carry3(a)
+    b = carry3(b)
+    bmat = b[..., _GATHER_IDX] * jnp.asarray(_GATHER_MASK)
+    if DTYPE == jnp.float32:
+        # Post-carry3 limbs lie in [-1, BASE+1] ⊂ bf16-exact integers, so the
+        # banded contraction is a native bf16×bf16→f32 MXU dot: products are
+        # exact (8-bit × 8-bit mantissas) and the 50-term sums stay < 2^24.
+        c = jnp.einsum(
+            "...i,...ik->...k",
+            a.astype(jnp.bfloat16),
+            bmat.astype(jnp.bfloat16),
+            preferred_element_type=jnp.float32,
+        )
+    else:
+        c = jnp.einsum("...i,...ik->...k", a, bmat, preferred_element_type=DTYPE)
+    return reduce_conv(c)
+
+
+def sqr(a: jnp.ndarray) -> jnp.ndarray:
+    return mul(a, a)
+
+
+def mul_n(pairs) -> list:
+    """Many independent Fq products as ONE stacked convolution.
+
+    XLA compile time scales with the number of dot_generals in a graph
+    (≈0.3 s each for this shape on CPU); a Miller-loop body written with
+    per-product `mul` calls takes minutes to compile.  Stacking n products
+    along a new leading axis costs one concat/slice pair and compiles —
+    and runs — as a single large batch multiply.  Operands must share a
+    broadcastable batch shape.
+    """
+    if len(pairs) == 1:
+        return [mul(pairs[0][0], pairs[0][1])]
+    common = ()
+    for a, b in pairs:
+        common = jnp.broadcast_shapes(common, jnp.shape(a), jnp.shape(b))
+    A = jnp.stack([jnp.broadcast_to(jnp.asarray(a), common) for a, _ in pairs])
+    B = jnp.stack([jnp.broadcast_to(jnp.asarray(b), common) for _, b in pairs])
+    C = mul(A, B)
+    return [C[i] for i in range(len(pairs))]
+
+
+def mul_small(a: jnp.ndarray, k: int) -> jnp.ndarray:
+    """Multiply by a small int k, |k| < 2^15 (k may be negative).
+
+    The input is renormalized first so the scaled limbs stay inside the
+    float32 exact-integer envelope (257 · 2^15 < 2^24).
+    """
+    if not -(1 << 15) < k < (1 << 15):
+        raise ValueError("|k| must be < 2^15")
+    return reduce_small(carry3(a) * jnp.asarray(k, DTYPE))
+
+
+def reduce_small(x: jnp.ndarray) -> jnp.ndarray:
+    """Renormalize a NLIMBS-limb vector whose limbs grew (adds, scalars)."""
+    x = carry3(x)
+    x = _fold(x, _FOLD_J)
+    return carry3(x)
+
+
+def select(cond: jnp.ndarray, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Branchless per-item select; cond shape broadcasts against (..., NLIMBS)."""
+    return jnp.where(cond[..., None], a, b)
+
+
+def pow_fixed(x: jnp.ndarray, exponent: int) -> jnp.ndarray:
+    """x^exponent for a Python-int exponent baked into the graph.
+
+    On TPU the whole square-and-multiply chain runs inside ONE Pallas
+    kernel (fq_pallas.pow_fixed) — the scan form below dispatches 2
+    kernel calls per exponent bit, which at ~100 µs fixed cost per call
+    dominates everything for the 381-bit Fermat inverse.
+    """
+    if (
+        exponent >= 1
+        and _use_pallas()
+        and not os.environ.get("HBBFT_TPU_NO_FUSED")
+    ):
+        from hbbft_tpu.ops import fq_pallas
+
+        return fq_pallas.pow_fixed(x, exponent)
+    bits = [int(b) for b in bin(exponent)[2:]]
+    bits_arr = jnp.asarray(bits, dtype=jnp.int32)
+
+    def step(acc, bit):
+        acc = sqr(acc)
+        cond = jnp.broadcast_to(bit.astype(bool), acc.shape[:-1])
+        acc = select(cond, mul(acc, x), acc)
+        return acc, None
+
+    # Seed with 1 so the first iteration (MSB, always 1) sets acc = x.
+    ones = jnp.broadcast_to(jnp.asarray(ONE), x.shape)
+    acc, _ = jax.lax.scan(step, ones, bits_arr)
+    return acc
+
+
+def inv(x: jnp.ndarray) -> jnp.ndarray:
+    """Fermat inverse x^(Q-2).  ~760 muls — amortize with batch_inv."""
+    return pow_fixed(x, Q - 2)
+
+
+def batch_inv(x: jnp.ndarray) -> jnp.ndarray:
+    """Invert a batch (leading axis) of nonzero elements with ONE Fermat
+    inverse: parallel prefix/suffix product scans + the Montgomery trick."""
+    prefix = jax.lax.associative_scan(mul, x, axis=0)
+    suffix = jax.lax.associative_scan(mul, x, axis=0, reverse=True)
+    tinv = inv(prefix[-1])
+    one = jnp.broadcast_to(jnp.asarray(ONE), x[:1].shape)
+    pre = jnp.concatenate([one, prefix[:-1]], axis=0)  # prefix_{i-1}
+    suf = jnp.concatenate([suffix[1:], one], axis=0)  # suffix_{i+1}
+    return mul(mul(pre, suf), jnp.broadcast_to(tinv, x.shape))
+
+
+def is_zero_host(limbs) -> bool:
+    """Host-side exact zero test (the only canonical compare we ever need)."""
+    return to_int(limbs) == 0

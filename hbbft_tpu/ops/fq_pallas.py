@@ -37,7 +37,10 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from hbbft_tpu.ops import fq
+# The limb binding, NOT the facade: this kernel is limb-layout-only, and the
+# facade's module-level names are rebound to fq_rns when HBBFT_TPU_FQ_IMPL=rns
+# (the default).  fq.limb is captured before that rebinding (ADVICE r4 high).
+from hbbft_tpu.ops import fq_limb as fq
 
 TILE = 512  # lanes per grid step: 4 × (8, 128) VPU tiles
 

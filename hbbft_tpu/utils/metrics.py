@@ -46,6 +46,16 @@ class Counters:
     # >10%-risk item at 29 ms/doc).
     device_seconds: float = 0.0
     hash_g2_seconds: float = 0.0
+    # device_seconds split by dispatch kind (round-4 verdict task 7: the
+    # n16 on-chip epoch was 90% unattributed).  Sums to device_seconds up
+    # to the rare unkinded dispatch; zero-valued kinds are elided from
+    # bench rows.
+    device_seconds_pairing: float = 0.0  # exact pairing checks (+fallback)
+    device_seconds_rlc_sig: float = 0.0  # grouped RLC sig-share verifies
+    device_seconds_rlc_dec: float = 0.0  # grouped RLC dec-share verifies
+    device_seconds_combine: float = 0.0  # Lagrange combines (sig + dec)
+    device_seconds_sign: float = 0.0  # batched G2 sign ladders
+    device_seconds_decrypt: float = 0.0  # batched G1 decrypt-share ladders
 
     def snapshot(self) -> Dict[str, float]:
         return asdict(self)

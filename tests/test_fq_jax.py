@@ -10,7 +10,7 @@ import numpy as np
 import pytest
 
 from hbbft_tpu.crypto.field import Q
-from hbbft_tpu.ops import fq
+from hbbft_tpu.ops import fq_limb as fq  # limb arm, independent of the rns facade default
 
 
 def rnd_ints(rng, n):

@@ -13,7 +13,8 @@ import pytest
 import jax
 
 from hbbft_tpu.crypto.field import Q
-from hbbft_tpu.ops import fq, fq_pallas
+from hbbft_tpu.ops import fq_pallas
+from hbbft_tpu.ops import fq_limb as fq  # limb arm, independent of the rns facade default
 
 
 @pytest.fixture(scope="module")

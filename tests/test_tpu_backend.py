@@ -274,3 +274,44 @@ def test_combine_sig_shares_batch_reverify_falls_back(
     backend.device_combine_threshold = 2
     got = backend.combine_sig_shares_batch(pks, [(shares, doc)])
     assert got[0] == want  # fallback repaired it
+
+
+def test_device_seconds_attributed_by_kind(backend, keyset):
+    """Every device dispatch bills a kind split of device_seconds (round-4
+    verdict task 7: the n16 epoch's device time was 90% unattributed) —
+    sign ladders, grouped-RLC verifies, and combines each land in their
+    own counter, and the kinds sum to the total."""
+    sks, pks = keyset
+    c = backend.counters
+    kinds = [
+        "pairing", "rlc_sig", "rlc_dec", "combine", "sign", "decrypt",
+    ]
+
+    def split():
+        return {k: getattr(c, f"device_seconds_{k}") for k in kinds}
+
+    backend.device_combine_threshold = 2  # force device paths
+    doc = b"attribution-doc"
+    items = [(sks.secret_key_share(i), doc) for i in range(3)]
+
+    before = split()
+    shares = backend.sign_shares_batch(items)
+    after = split()
+    assert after["sign"] > before["sign"]
+
+    before = after
+    assert backend.verify_sig_shares(
+        [(pks.public_key_share(i), doc, shares[i]) for i in range(3)]
+    ) == [True] * 3
+    after = split()
+    assert after["rlc_sig"] > before["rlc_sig"]
+
+    before = after
+    backend.combine_signatures(pks, {0: shares[0], 1: shares[1]})
+    after = split()
+    assert after["combine"] > before["combine"]
+
+    # the kind split accounts for the total (unkinded dispatches none here)
+    assert abs(sum(after.values()) - c.device_seconds) < 1e-6 or (
+        sum(after.values()) <= c.device_seconds
+    )

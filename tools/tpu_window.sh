@@ -1,38 +1,68 @@
 #!/bin/bash
-# TPU-window runbook: run this THE MOMENT /tmp/tpu_alive exists (the
-# tunnel died for all of rounds 2-3; treat every live window as
-# preemptible — capture in strict priority order, flush after each step).
+# TPU-window runbook, round 5 — RESUMABLE. Run the moment /tmp/tpu_alive
+# exists (the tunnel died for all of rounds 2-3 and round 4's window died
+# after step 3 of 9; treat every live window as preemptible).
 #
 #   bash tools/tpu_window.sh | tee -a /tmp/tpu_window.log
+#   bash tools/tpu_window.sh --list     # show skip/pending, run nothing
 #
-# Round-4 priority order (VERDICT r3 "Next round" tasks 1-5):
-#   1. limb-vs-RNS kernel A/B on-chip (decides RNS default promotion)
-#      + the fused-chain VMEM-ceiling probe (fq_rns_pallas, task 2)
-#   2. flagship crypto rows + n16 real-crypto macro under RNS
-#   3. the same flagship subset under limb (graph-level A/B)
-#   4. N=100 f=33 real-crypto epochs (>=10, one era change) — the
-#      north star at its defined shape (task 3)
-#   5. config 2 at size: 10k coin flips, N=64 (task 5)
-#   6. full driver bench (fills every remaining row on TPU)
-#   7. RS encode int8-vs-bf16 dot A/B (task 4)
-#   8. per-mul fused RNS A/B (HBBFT_TPU_RNS_FUSED=all vs pow)
-#   9. extension-matmul strategy A/B (HBBFT_TPU_RNS_EXT highest/bf16/int8)
-# Each bench.py run OVERWRITES BENCH_rows.json with its own row set, so
-# a snapshot is copied to tpu_window_r04/ after every step — the
-# archive is the snapshot directory, and a dying tunnel can only lose
-# the CURRENT step.
+# Round-4 postmortem (VERDICT r4 Weak #4): the runbook ran strictly
+# top-to-bottom and re-measured already-recorded steps while the
+# highest-value uncaptured step (the N=100 north star) waited; the window
+# died first. This version CONTENT-CHECKS each step's snapshot and runs
+# only missing steps, highest priority first — so a resumed window's
+# first minute goes to the top uncaptured item.
+#
+# Round-5 priority (VERDICT r4 "Next round" tasks):
+#   n100          north star: N=100 f=33 real-crypto >=10 epochs + era
+#                 change on TpuBackend (task 1)
+#   matrix_*      cross-impl flagship matrix, INTERLEAVED trials
+#                 (rns/limb/rns/limb) for the variance note (task 3,
+#                 Weak #1/#7): share_verify, rlc_sig, g2_sign, rlc_dec,
+#                 coin under both impls from one window
+#   flips10k      config 2 at size: 10k coin flips N=64 (task 5)
+#   n64coin       n64 real-coin macro on TpuBackend (task 5b)
+#   rs_ab         RS encode dot-strategy A/B + shard sweep (task 6)
+#   kernel_levers TILE sweep / RNS_FUSED=all / EXT strategies with the
+#                 corrected throughput roofline (task 4)
+#   driver_budget full flagship-first BENCH_BUDGET bench — exactly what
+#                 the driver will run, validated on-chip (task 2b/8)
+#
+# Each bench.py run OVERWRITES BENCH_rows.json, so a snapshot is copied
+# into $ART after every step; the archive is the snapshot directory and a
+# dying tunnel can only lose the CURRENT step.
 set -u
 cd "$(dirname "$0")/.."
 TS() { date -u +%H:%M:%S; }
-ART=tpu_window_r04
+ART=${TPU_WINDOW_ART:-tpu_window_r05}
 mkdir -p "$ART"
 SNAP() { cp -f BENCH_rows.json "$ART/rows_after_$1.json" 2>/dev/null || true; }
-# Abort between steps when the tunnel has died: the remaining steps
-# would silently run (and record) CPU fallback instead, overwriting
-# BENCH_rows.json with cpu rows and burning the wall clock.  A FRESH
-# watcher flag (<400s, the bench.py staleness bound) short-circuits;
-# otherwise — flag missing (watcher restarting?) or stale (watcher
-# dead?) — one direct probe decides, so neither case misfires.
+
+# has_row FILE METRIC [key=value ...] — true when FILE has a completed row
+# for METRIC matching every key=value (content check, not existence: a
+# crashed step leaves a snapshot without its row and must re-run).
+has_row() {
+  python - "$@" <<'PY'
+import json, sys
+path, metric = sys.argv[1], sys.argv[2]
+want = dict(kv.split("=", 1) for kv in sys.argv[3:])
+try:
+    rows = json.load(open(path)).get("rows", [])
+except Exception:
+    sys.exit(1)
+for r in rows:
+    if r.get("metric") != metric or "value" not in r:
+        continue
+    if all(str(r.get(k)) == v for k, v in want.items()):
+        sys.exit(0)
+sys.exit(1)
+PY
+}
+
+# Abort when the tunnel has died: the remaining steps would silently run
+# (and record) CPU fallback instead. A FRESH watcher flag (<400s, the
+# bench.py staleness bound) short-circuits; otherwise one direct probe
+# decides.
 ALIVE() {
   if [ -f /tmp/tpu_alive ]; then
     age=$(( $(date +%s) - $(stat -c %Y /tmp/tpu_alive 2>/dev/null || echo 0) ))
@@ -49,70 +79,106 @@ print('OK')" 2>/dev/null | grep -c '^OK')
   fi
 }
 
-echo "=== $(TS) step 1: kernel A/B limb vs rns (+fused-chain probe) ==="
-timeout 1200 python tools/kernel_bench.py 2>&1 | tee "$ART/kernel_limb.log"
-HBBFT_TPU_FQ_IMPL=rns timeout 1800 python tools/kernel_bench.py 2>&1 \
-  | tee "$ART/kernel_rns.log"
+MATRIX_ONLY=share_verify,rlc_sig,g2_sign,rlc_dec,coin_e2e
 
-ALIVE
-echo "=== $(TS) step 2: flagship rows + n16 real-crypto under rns ==="
-HBBFT_TPU_FQ_IMPL=rns \
-  BENCH_ONLY=rlc_dec,rlc_sig,coin_e2e,g2_sign,share_verify,rlc_dec_adversarial,array_n16_tpu \
-  timeout 3600 python bench.py
-SNAP step2_rns
+# --- step done-checks (content-verified) -----------------------------------
+done_n100() {
+  has_row "$ART/rows_after_n100.json" array_epochs_per_sec_n100 backend=TpuBackend
+}
+done_matrix_rns_a() {
+  has_row "$ART/rows_after_matrix_rns_a.json" rlc_dec_verify_throughput fq_impl=rns
+}
+done_matrix_limb_a() {
+  has_row "$ART/rows_after_matrix_limb_a.json" rlc_dec_verify_throughput fq_impl=limb
+}
+done_matrix_rns_b() {
+  has_row "$ART/rows_after_matrix_rns_b.json" rlc_dec_verify_throughput fq_impl=rns
+}
+done_matrix_limb_b() {
+  has_row "$ART/rows_after_matrix_limb_b.json" rlc_dec_verify_throughput fq_impl=limb
+}
+done_flips10k() {
+  has_row "$ART/rows_after_flips10k.json" coin_flips_per_sec flips=10000
+}
+done_n64coin() {
+  has_row "$ART/rows_after_n64coin.json" array_epochs_per_sec_n64_coin backend=TpuBackend
+}
+done_rs_ab() {
+  has_row "$ART/rows_after_rs_ab.json" rs_encode_throughput
+}
+done_kernel_levers() {
+  grep -q "fused-chain" "$ART/kernel_levers.log" 2>/dev/null
+}
+done_driver_budget() {
+  has_row "$ART/rows_after_driver_budget.json" rlc_dec_verify_throughput platform=tpu
+}
 
-ALIVE
-echo "=== $(TS) step 3: rlc_dec + coin under limb (graph A/B) ==="
-BENCH_ONLY=rlc_dec,coin_e2e timeout 1800 python bench.py
-SNAP step3_limb
+# --- step bodies ------------------------------------------------------------
+do_n100() {
+  HBBFT_TPU_FQ_IMPL=rns BENCH_ONLY=array_n100 BENCH_ARRAY_BACKEND=tpu \
+    BENCH_ARRAY_EPOCHS=10 BENCH_ARRAY_CHURN=1 \
+    timeout 7200 python bench.py
+}
+do_matrix_rns_a()  { HBBFT_TPU_FQ_IMPL=rns  BENCH_ONLY=$MATRIX_ONLY timeout 1800 python bench.py; }
+do_matrix_limb_a() { HBBFT_TPU_FQ_IMPL=limb BENCH_ONLY=$MATRIX_ONLY timeout 1800 python bench.py; }
+do_matrix_rns_b()  { HBBFT_TPU_FQ_IMPL=rns  BENCH_ONLY=$MATRIX_ONLY timeout 1800 python bench.py; }
+do_matrix_limb_b() { HBBFT_TPU_FQ_IMPL=limb BENCH_ONLY=$MATRIX_ONLY timeout 1800 python bench.py; }
+do_flips10k() {
+  HBBFT_TPU_FQ_IMPL=rns BENCH_ONLY=coin_e2e BENCH_COIN_FLIPS=10000 \
+    timeout 3600 python bench.py
+}
+do_n64coin() {
+  HBBFT_TPU_FQ_IMPL=rns BENCH_ONLY=array_n64_coin BENCH_COIN_MACRO_BACKEND=tpu \
+    timeout 1800 python bench.py
+}
+do_rs_ab() {
+  BENCH_ONLY=rs_encode timeout 900 python bench.py
+  SNAP rs_default
+  BENCH_ONLY=rs_encode HBBFT_TPU_GF_DOT=bf16 timeout 900 python bench.py
+  SNAP rs_bf16
+  BENCH_ONLY=rs_encode HBBFT_TPU_GF_DOT=bf16 BENCH_RS_SHARD=65536 \
+    timeout 900 python bench.py
+}
+do_kernel_levers() {
+  : > "$ART/kernel_levers.log"
+  # corrected roofline + default fused chain (rns)
+  HBBFT_TPU_FQ_IMPL=rns timeout 1200 python tools/kernel_bench.py 2>&1 \
+    | tee -a "$ART/kernel_levers.log"
+  # TILE sweep on the fused chain
+  for tile in 128 256 512 1024; do
+    HBBFT_TPU_FQ_IMPL=rns HBBFT_TPU_RNS_TILE=$tile KB_NO_ROOFLINE=1 \
+      KB_LANES=262144 timeout 900 python tools/kernel_bench.py 2>&1 \
+      | tee -a "$ART/kernel_levers.log"
+  done
+  # extension-matmul strategy A/B at one size
+  for ext in bf16 int8; do
+    HBBFT_TPU_FQ_IMPL=rns HBBFT_TPU_RNS_EXT=$ext KB_FUSED=0 KB_NO_ROOFLINE=1 \
+      KB_LANES=65536 timeout 900 python tools/kernel_bench.py 2>&1 \
+      | tee -a "$ART/kernel_levers.log"
+  done
+  # per-mul fused RNS on the flagship graph row
+  HBBFT_TPU_FQ_IMPL=rns HBBFT_TPU_RNS_FUSED=all BENCH_ONLY=rlc_dec \
+    timeout 1800 python bench.py
+  SNAP fused_all
+}
+do_driver_budget() {
+  HBBFT_TPU_FQ_IMPL=rns BENCH_BUDGET=3000 timeout 3600 python bench.py
+}
 
-ALIVE
-echo "=== $(TS) step 4: N=100 real-crypto epochs + era change ==="
-HBBFT_TPU_FQ_IMPL=rns BENCH_ONLY=array_n100 BENCH_ARRAY_BACKEND=tpu \
-  BENCH_ARRAY_EPOCHS=10 BENCH_ARRAY_CHURN=1 \
-  timeout 5400 python bench.py
-SNAP step4_n100
+STEPS="n100 matrix_rns_a matrix_limb_a matrix_rns_b matrix_limb_b flips10k n64coin rs_ab kernel_levers driver_budget"
 
-ALIVE
-echo "=== $(TS) step 5: config 2 at size (10k flips; n64 coin macro) ==="
-HBBFT_TPU_FQ_IMPL=rns BENCH_ONLY=coin_e2e BENCH_COIN_FLIPS=10000 \
-  timeout 3600 python bench.py
-SNAP step5_flips
-HBBFT_TPU_FQ_IMPL=rns BENCH_ONLY=array_n64_coin BENCH_COIN_MACRO_BACKEND=tpu \
-  timeout 1800 python bench.py
-SNAP step5_macro
-
-ALIVE
-echo "=== $(TS) step 6: full driver bench (tpu; fq A/B inside) ==="
-HBBFT_TPU_FQ_IMPL=rns timeout 5400 python bench.py
-cp -f BENCH_rows.json "$ART/rows_full_rns.json" 2>/dev/null || true
-
-ALIVE
-echo "=== $(TS) step 7: RS encode (int8 vs bf16 dot A/B) ==="
-BENCH_ONLY=rs_encode timeout 900 python bench.py
-BENCH_ONLY=rs_encode HBBFT_TPU_GF_DOT=bf16 timeout 900 python bench.py
-BENCH_ONLY=rs_encode HBBFT_TPU_GF_DOT=bf16 BENCH_RS_SHARD=65536 \
-  timeout 900 python bench.py
-SNAP step7_rs
-
-ALIVE
-echo "=== $(TS) step 8: per-mul fused RNS A/B on the flagship row ==="
-HBBFT_TPU_FQ_IMPL=rns HBBFT_TPU_RNS_FUSED=all BENCH_ONLY=rlc_dec \
-  timeout 1800 python bench.py
-SNAP step8_fused_all
-
-ALIVE
-echo "=== $(TS) step 9: extension-matmul strategy A/B (single size) ==="
-# HIGHEST (6 MXU passes) vs explicit bf16 planes (4) vs int8 MXU
-HBBFT_TPU_FQ_IMPL=rns HBBFT_TPU_RNS_EXT=bf16 KB_FUSED=0 KB_NO_ROOFLINE=1 \
-  KB_LANES=65536 timeout 900 python tools/kernel_bench.py 2>&1 \
-  | tee "$ART/kernel_rns_bf16.log"
-HBBFT_TPU_FQ_IMPL=rns HBBFT_TPU_RNS_EXT=int8 KB_FUSED=0 KB_NO_ROOFLINE=1 \
-  KB_LANES=65536 timeout 900 python tools/kernel_bench.py 2>&1 \
-  | tee "$ART/kernel_rns_int8.log"
-# if either wins on the rlc_dec graph too, promote via env default:
-HBBFT_TPU_FQ_IMPL=rns HBBFT_TPU_RNS_EXT=int8 BENCH_ONLY=rlc_dec \
-  timeout 1200 python bench.py
-SNAP step9_ext_ab
-
-echo "=== $(TS) done — snapshots in $ART/ ==="
+for s in $STEPS; do
+  if "done_$s"; then
+    echo "=== $(TS) skip $s (snapshot verified) ==="
+    continue
+  fi
+  if [ "${1:-}" = "--list" ]; then
+    echo "pending: $s"
+    continue
+  fi
+  ALIVE
+  echo "=== $(TS) step $s ==="
+  "do_$s"
+  SNAP "$s"
+done
+echo "=== $(TS) runbook pass complete — snapshots in $ART/ ==="
