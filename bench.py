@@ -568,6 +568,10 @@ def bench_fq_kernel() -> dict:
         env["KB_LANES"] = lanes
         env["KB_CHAIN"] = chain
         env["KB_NO_ROOFLINE"] = "1"  # probe is step-independent, full-size
+        # this row is the UNFUSED fq.mul A/B: without this the rns arm's
+        # fused-chain sweep would print last and m[-1] would silently
+        # record the fused rate against limb's unfused one
+        env["KB_FUSED"] = "0"
         proc = subprocess.run(
             [sys.executable, os.path.join("tools", "kernel_bench.py")],
             capture_output=True,

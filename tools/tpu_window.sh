@@ -107,7 +107,10 @@ done_rs_ab() {
   has_row "$ART/rows_after_rs_ab.json" rs_encode_throughput
 }
 done_kernel_levers() {
-  grep -q "fused-chain" "$ART/kernel_levers.log" 2>/dev/null
+  # completion marker written at the END of the step: a mid-step death
+  # must re-run it (the first sub-command already prints fused-chain
+  # lines, so grepping those would mark a dead step complete)
+  grep -q "KERNEL_LEVERS_COMPLETE" "$ART/kernel_levers.log" 2>/dev/null
 }
 done_driver_budget() {
   has_row "$ART/rows_after_driver_budget.json" rlc_dec_verify_throughput platform=tpu
@@ -140,6 +143,10 @@ do_rs_ab() {
     timeout 900 python bench.py
 }
 do_kernel_levers() {
+  # body runs under -e/pipefail so a failed sub-command (timeout rc=124,
+  # crashed sweep) aborts the step and the completion marker is NOT
+  # written — partial logs stay, the next pass re-runs the step
+  ( set -e -o pipefail
   : > "$ART/kernel_levers.log"
   # corrected roofline + default fused chain (rns)
   HBBFT_TPU_FQ_IMPL=rns timeout 1200 python tools/kernel_bench.py 2>&1 \
@@ -160,6 +167,7 @@ do_kernel_levers() {
   HBBFT_TPU_FQ_IMPL=rns HBBFT_TPU_RNS_FUSED=all BENCH_ONLY=rlc_dec \
     timeout 1800 python bench.py
   SNAP fused_all
+  ) && echo "KERNEL_LEVERS_COMPLETE" >> "$ART/kernel_levers.log"
 }
 do_driver_budget() {
   HBBFT_TPU_FQ_IMPL=rns BENCH_BUDGET=3000 timeout 3600 python bench.py
