@@ -845,6 +845,7 @@ def _bench_array_engine(
         "device_seconds_combine": 0.0,
         "device_seconds_sign": 0.0,
         "device_seconds_decrypt": 0.0,
+        "device_seconds_dkg": 0.0,
     }
     # mid-run only: era changes need a preceding and a following epoch, so
     # indices clamp to [1, epochs-1] and dedupe (epochs < 2 → no churn; the
@@ -884,6 +885,7 @@ def _bench_array_engine(
         "vs_baseline": round(eps / baseline_eps, 3),
         "baseline": "estimated",
         "runtime": "array",
+        "n": n,
         "backend": backend.name,
         "dedup": dedup,
         "dynamic": dynamic,

@@ -176,6 +176,30 @@ class CryptoBackend(abc.ABC):
         """
         return [sk.decrypt_share_unchecked(ct) for sk, ct in items]
 
+    def g1_mul_batch(self, scalars: Sequence[int], points: Sequence[Any]) -> List[Any]:
+        """Batched independent G1 scalar multiplications s_i·P_i — the
+        primitive the batched era-change DKG (engine/dkg_batch.py) feeds
+        with commitment/encryption/decryption ladders.  Device backends
+        override with batched ladder dispatches."""
+        g = self.group
+        return [g.g1_mul(s, p) for s, p in zip(scalars, points)]
+
+    def g2_mul_batch(self, scalars: Sequence[int], points: Sequence[Any]) -> List[Any]:
+        """Batched independent G2 scalar multiplications (ciphertext W
+        components in the batched DKG)."""
+        g = self.group
+        return [g.g2_mul(s, p) for s, p in zip(scalars, points)]
+
+    def g1_lincomb(self, scalars: Sequence[int], points: Sequence[Any]) -> Any:
+        """One multi-scalar combination Σ s_i·P_i — the aggregated side of
+        the DKG's RLC commitment checks (one MSM replaces N³ per-item
+        Horner evaluations).  Default: batched muls + host fold."""
+        g = self.group
+        acc = g.g1_identity()
+        for el in self.g1_mul_batch(scalars, points):
+            acc = g.g1_add(acc, el)
+        return acc
+
     # -- misc ----------------------------------------------------------------
 
     @property

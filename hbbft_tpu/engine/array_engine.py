@@ -46,6 +46,7 @@ job; the array engine targets the honest-path throughput configs.
 
 from __future__ import annotations
 
+import os
 import random
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Sequence
@@ -507,35 +508,68 @@ class ArrayHoneyBadgerNet:
         rep.votes_verified += len(vote_items)
 
         # 2) full SyncKeyGen among all N (lockstep Part then Ack phases).
-        from hbbft_tpu.protocols.sync_key_gen import SyncKeyGen
+        #
+        # Two equivalent engines for the same protocol math:
+        #   batched (default) — engine/dkg_batch.py: device-batched
+        #     ladders + batched pairing checks + RLC-aggregated commitment
+        #     checks.  The per-node path is O(N³) SEQUENTIAL host crypto
+        #     (measured round 5: a multi-day job at N=100 — each ack is an
+        #     individually pairing-verified ciphertext in pure Python).
+        #   pernode — the original lockstep SyncKeyGen objects, kept as
+        #     the golden cross-check (HBBFT_TPU_DKG=pernode; equivalence
+        #     asserted in tests/test_dkg_batch.py).
+        dkg_mode = os.environ.get("HBBFT_TPU_DKG", "batched")
+        if dkg_mode == "batched":
+            from hbbft_tpu.engine.dkg_batch import batched_era_dkg
 
-        kgs: Dict[Any, SyncKeyGen] = {}
-        parts = {}
-        for nid in self.ids:
-            kg, part = SyncKeyGen.new(
-                nid, self.netinfos[nid].secret_key, pub_keys, f, self.rng, g
+            self._count_msgs(rep, n * (n - 1))  # Part: Target.All
+            self._count_msgs(rep, n * n * (n - 1))  # Ack: Target.All
+            first, shares, kstats = batched_era_dkg(
+                self.backend,
+                self.ids,
+                {nid: self.netinfos[nid].secret_key.x for nid in self.ids},
+                {nid: pub_keys[nid].el for nid in self.ids},
+                f,
+                self.rng,
             )
-            kgs[nid] = kg
-            parts[nid] = part
-        self._count_msgs(rep, n * (n - 1))  # Part: Target.All
-        acks = []
-        for proposer in self.ids:
+            rep.kg_parts_handled += kstats.parts_handled
+            rep.kg_acks_handled += kstats.acks_handled
+            rep.ciphertexts_verified += kstats.ciphertexts_verified
+            rep.hashes += kstats.hashes_g2
+            rep.rounds += 2
+            results = {nid: (first, shares[nid]) for nid in self.ids}
+        else:
+            from hbbft_tpu.protocols.sync_key_gen import SyncKeyGen
+
+            kgs: Dict[Any, SyncKeyGen] = {}
+            parts = {}
             for nid in self.ids:
-                out = kgs[nid].handle_part(proposer, parts[proposer], self.rng)
-                assert out.fault is None, out.fault
-                if out.ack is not None:
-                    acks.append((nid, out.ack))
-                rep.kg_parts_handled += 1
-        self._count_msgs(rep, n * n * (n - 1))  # Ack: Target.All per part
-        for acker, ack in acks:
-            for nid in self.ids:
-                out = kgs[nid].handle_ack(acker, ack)
-                assert out.fault is None, out.fault
-                rep.kg_acks_handled += 1
-        rep.rounds += 2
+                kg, part = SyncKeyGen.new(
+                    nid, self.netinfos[nid].secret_key, pub_keys, f, self.rng, g
+                )
+                kgs[nid] = kg
+                parts[nid] = part
+            self._count_msgs(rep, n * (n - 1))  # Part: Target.All
+            acks = []
+            for proposer in self.ids:
+                for nid in self.ids:
+                    out = kgs[nid].handle_part(
+                        proposer, parts[proposer], self.rng
+                    )
+                    assert out.fault is None, out.fault
+                    if out.ack is not None:
+                        acks.append((nid, out.ack))
+                    rep.kg_parts_handled += 1
+            self._count_msgs(rep, n * n * (n - 1))  # Ack: Target.All per part
+            for acker, ack in acks:
+                for nid in self.ids:
+                    out = kgs[nid].handle_ack(acker, ack)
+                    assert out.fault is None, out.fault
+                    rep.kg_acks_handled += 1
+            rep.rounds += 2
+            results = {nid: kgs[nid].generate() for nid in self.ids}
 
         # 3) era turnover: everyone must derive the same key set.
-        results = {nid: kgs[nid].generate() for nid in self.ids}
         first = results[self.ids[0]][0]
         assert all(results[nid][0] == first for nid in self.ids), (
             "array engine: DKG public key set disagreement"

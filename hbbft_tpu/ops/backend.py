@@ -202,19 +202,33 @@ class TpuBackend(CryptoBackend):
         self._h2_cache[doc] = h
         return h
 
+    #: Max pairing checks per device dispatch.  The Miller-loop graph
+    #: carries fq12 state (12 x 79 f32 lanes) per item plus staged
+    #: intermediates — far heavier per lane than the scalar ladders, so
+    #: the cap sits well below device_lane_cap.  The batched DKG feeds
+    #: N³-sized ciphertext batches through here (engine/dkg_batch.py);
+    #: without the cap a single 32k+-lane pairing dispatch OOMs HBM.
+    pairing_lane_cap = int(os.environ.get("HBBFT_TPU_PAIR_CAP", "2048"))
+
     def _check_batch(self, quads) -> List[bool]:
         """quads: list of (a1, b1, a2, b2) affine tuples checking
         e(a1,b1) == e(a2,b2).  Returns per-item booleans."""
+        quads = list(quads)
         n = len(quads)
         if n == 0:
             return []
+        if n > self.pairing_lane_cap:
+            out: List[bool] = []
+            for lo in range(0, n, self.pairing_lane_cap):
+                out.extend(self._check_batch(quads[lo : lo + self.pairing_lane_cap]))
+            return out
         self.counters.pairing_checks += n
         self.counters.device_dispatches += 1
         g1 = self.group.g1()
         g2 = self.group.g2()
         pad = (g1, g2, g1, g2)  # trivially true
         b = self._pad_bucket(n)
-        quads = list(quads) + [pad] * (b - n)
+        quads = quads + [pad] * (b - n)
 
         neg = self.group.g1_neg
         P1 = pairing.g1_affine_to_device([q[0] for q in quads])
@@ -832,3 +846,36 @@ class TpuBackend(CryptoBackend):
             el if isinstance(el, DecryptionShare) else DecryptionShare(self.group, el)
             for el in els
         ]
+
+    def g1_mul_batch(self, scalars: Sequence[int], points: Sequence[Any]) -> List[Any]:
+        """Batched independent G1 ladders s_i·P_i for the batched DKG
+        (engine/dkg_batch.py): commitment coefficient muls, ciphertext
+        U/shared components, row/value decrypt ladders.
+
+        Precondition (as for decrypt_shares_batch): points have order r —
+        the DKG feeds generator multiples and honestly-encrypted U values.
+        """
+        return self._ladder_batch(
+            list(scalars),
+            list(points),
+            lambda i: self.group.g1_mul(scalars[i], points[i]),
+            lambda sub: self.g1_mul_batch(scalars[sub], list(points)[sub]),
+            curve.g1_to_device,
+            curve.g1_from_device,
+            _jitted_g1_mul_batch(),
+            kind="dkg",
+        )
+
+    def g2_mul_batch(self, scalars: Sequence[int], points: Sequence[Any]) -> List[Any]:
+        """Batched independent G2 ladders (DKG ciphertext W = s·H2(U‖V))."""
+        return self._ladder_batch(
+            list(scalars),
+            list(points),
+            lambda i: self.group.g2_mul(scalars[i], points[i]),
+            lambda sub: self.g2_mul_batch(scalars[sub], list(points)[sub]),
+            curve.g2_to_device,
+            curve.g2_from_device,
+            _jitted_g2_mul_batch(),
+            kind="dkg",
+        )
+

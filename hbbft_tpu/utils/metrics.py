@@ -56,6 +56,7 @@ class Counters:
     device_seconds_combine: float = 0.0  # Lagrange combines (sig + dec)
     device_seconds_sign: float = 0.0  # batched G2 sign ladders
     device_seconds_decrypt: float = 0.0  # batched G1 decrypt-share ladders
+    device_seconds_dkg: float = 0.0  # batched era-change DKG ladders/MSMs
 
     def snapshot(self) -> Dict[str, float]:
         return asdict(self)

@@ -118,8 +118,12 @@ done_driver_budget() {
 
 # --- step bodies ------------------------------------------------------------
 do_n100() {
+  # churn=0 deliberately: even with the round-5 device-batched DKG the
+  # N=100 era change is ~7.7h of host hash-to-G2 (PERF.md round-5
+  # itemization) — out of scope this round.  Churn evidence comes from
+  # the n16_churn / n32_churn steps below, on the batched DKG path.
   HBBFT_TPU_FQ_IMPL=rns BENCH_ONLY=array_n100 BENCH_ARRAY_BACKEND=tpu \
-    BENCH_ARRAY_EPOCHS=10 BENCH_ARRAY_CHURN=1 \
+    BENCH_ARRAY_EPOCHS=10 BENCH_ARRAY_CHURN=0 \
     timeout 7200 python bench.py
 }
 do_matrix_rns_a()  { HBBFT_TPU_FQ_IMPL=rns  BENCH_ONLY=$MATRIX_ONLY timeout 1800 python bench.py; }
@@ -172,8 +176,32 @@ do_kernel_levers() {
 do_driver_budget() {
   HBBFT_TPU_FQ_IMPL=rns BENCH_BUDGET=3000 timeout 3600 python bench.py
 }
+done_n32_churn() {
+  has_row "$ART/rows_after_n32_churn.json" array_epochs_per_sec_n100 \
+    backend=TpuBackend n=32
+}
+do_n32_churn() {
+  # real-crypto era change ON DEVICE via the batched DKG
+  # (engine/dkg_batch.py).  N=32 f=10: ~15 min host hash-to-G2 (the
+  # measured 13.65 ms/doc wall, 2x32^3 docs) + batched device
+  # ladders/pairings.  N=100 churn at full fidelity is ~7.7 h of host
+  # hashing — itemized in PERF.md, native hash kernel is the next lever.
+  HBBFT_TPU_FQ_IMPL=rns BENCH_ONLY=array_n100 BENCH_ARRAY_BACKEND=tpu \
+    BENCH_ARRAY_N=32 BENCH_ARRAY_EPOCHS=3 BENCH_ARRAY_CHURN=1 \
+    timeout 5400 python bench.py
+}
+done_n16_churn() {
+  has_row "$ART/rows_after_n16_churn.json" array_epochs_per_sec_n100 \
+    backend=TpuBackend n=16
+}
+do_n16_churn() {
+  # quick churn row: batched-DKG era change at the config-1 size
+  HBBFT_TPU_FQ_IMPL=rns BENCH_ONLY=array_n100 BENCH_ARRAY_BACKEND=tpu \
+    BENCH_ARRAY_N=16 BENCH_ARRAY_EPOCHS=3 BENCH_ARRAY_CHURN=1 \
+    timeout 3600 python bench.py
+}
 
-STEPS="n100 matrix_rns_a matrix_limb_a matrix_rns_b matrix_limb_b flips10k n64coin rs_ab kernel_levers driver_budget"
+STEPS="n100 matrix_rns_a matrix_limb_a matrix_rns_b matrix_limb_b n16_churn flips10k n64coin rs_ab n32_churn kernel_levers driver_budget"
 
 for s in $STEPS; do
   if "done_$s"; then
