@@ -629,7 +629,7 @@ def hash_to_g1(data: bytes):
         ctr += 1
 
 
-def hash_to_g2(data: bytes):
+def _hash_to_g2_pure(data: bytes):
     ctr = 0
     while True:
         x = (
@@ -646,6 +646,21 @@ def hash_to_g2(data: bytes):
             if p is not None:
                 return p
         ctr += 1
+
+
+def hash_to_g2(data: bytes):
+    """Native C kernel when available (point-for-point identical, golden-
+    checked at first use — native/hashg2_kernel.c), else the pure path.
+
+    The pure path costs 13.65 ms/doc (~87% in the affine cofactor
+    clearing); the DKG hashes 2(N²+N³) docs per era change, so this is
+    the macro-scale host wall (PERF.md round 5)."""
+    from hbbft_tpu import native
+
+    p = native.hashg2(data, pure_fn=_hash_to_g2_pure)
+    if p is not None:
+        return p
+    return _hash_to_g2_pure(data)
 
 
 # ---------------------------------------------------------------------------

@@ -244,3 +244,77 @@ def merkle_root_batch(
     out = np.empty((t, 32), dtype=np.uint8)
     lib.merkle_root_batch(leaves, t, n_leaves, leaf_len, size, int(reps), out)
     return out
+
+
+# ---------------------------------------------------------------------------
+# hash-to-G2 kernel (hashg2_kernel.c) — the DKG/coin host-hash wall
+# ---------------------------------------------------------------------------
+
+_HG2_SRC = os.path.join(_DIR, "hashg2_kernel.c")
+_HG2_SO = os.path.join(_DIR, f"_hashg2_kernel.{_host_tag()}.so")
+_hg2_lib = None
+_hg2_tried = False
+_hg2_checked = False
+
+
+def _load_hashg2():
+    global _hg2_lib, _hg2_tried
+    if _hg2_lib is not None or _hg2_tried:
+        return _hg2_lib
+    _hg2_tried = True
+    if os.environ.get("HBBFT_TPU_NO_NATIVE_HASHG2"):
+        return None
+    if not _build_so(_HG2_SRC, _HG2_SO):
+        return None
+    try:
+        lib = ctypes.CDLL(_HG2_SO)
+    except OSError:
+        return None
+    u8p = np.ctypeslib.ndpointer(dtype=np.uint8, flags="C_CONTIGUOUS")
+    u64p = np.ctypeslib.ndpointer(dtype=np.uint64, flags="C_CONTIGUOUS")
+    lib.hashg2_one.argtypes = [u8p, ctypes.c_long, u64p]
+    lib.hashg2_one.restype = ctypes.c_int
+    _hg2_lib = lib
+    return _hg2_lib
+
+
+def _limbs_to_int(limbs) -> int:
+    out = 0
+    for i, v in enumerate(limbs):
+        out |= int(v) << (64 * i)
+    return out
+
+
+def hashg2(data: bytes, pure_fn=None):
+    """Native hash-to-G2: ((x0, x1), (y0, y1)) ints, or None if the kernel
+    is unavailable.  ``pure_fn`` (the golden Python hash) is required on
+    the FIRST call: the kernel is golden-checked against it on a few docs
+    and permanently disabled on any mismatch — the native path must be
+    point-for-point interchangeable with the pure one."""
+    global _hg2_lib, _hg2_checked
+    lib = _load_hashg2()
+    if lib is None:
+        return None
+    out = np.empty(24, dtype=np.uint64)
+
+    def one(doc: bytes):
+        buf = np.frombuffer(doc, dtype=np.uint8) if doc else np.empty(0, np.uint8)
+        rc = lib.hashg2_one(np.ascontiguousarray(buf), len(doc), out)
+        if rc != 0:
+            return None
+        return (
+            (_limbs_to_int(out[0:6]), _limbs_to_int(out[6:12])),
+            (_limbs_to_int(out[12:18]), _limbs_to_int(out[18:24])),
+        )
+
+    if not _hg2_checked:
+        if pure_fn is None:
+            return None  # cannot self-test yet; caller retries with pure_fn
+        for probe in (b"", b"g2-golden-0", b"g2-golden-1", bytes(range(97))):
+            got = one(probe)
+            if got is None or got != pure_fn(probe):
+                _hg2_lib = None  # mismatch: disable permanently
+                return None
+        _hg2_checked = True
+    return one(data)
+

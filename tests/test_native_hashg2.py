@@ -1,0 +1,72 @@
+"""Native hash-to-G2 kernel vs the pure golden path.
+
+The C kernel (native/hashg2_kernel.c) must be point-for-point identical
+with crypto/bls381._hash_to_g2_pure — same try-and-increment schedule,
+same deterministic sign choice, same Budroni-Pintore clearing — because
+call sites treat the two as interchangeable (signatures hash-compare
+across backends).  The loader's own golden self-test guards first use;
+these tests pin the contract in CI and the env kill-switch.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+import hbbft_tpu.crypto.bls381 as B
+from hbbft_tpu import native
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def kernel():
+    if native.hashg2(b"probe", pure_fn=B._hash_to_g2_pure) is None:
+        pytest.skip("no C toolchain / kernel unavailable")
+    return True
+
+
+def test_matches_pure_on_varied_docs(kernel):
+    docs = [
+        b"",
+        b"a",
+        b"doc-needing-retries-0",
+        b"x" * 55,   # single-block boundary
+        b"y" * 56,   # padding spills to a second block
+        b"z" * 64,
+        bytes(range(256)),
+        b"coin" * 300,
+    ]
+    for d in docs:
+        assert B.hash_to_g2(d) == B._hash_to_g2_pure(d), d[:16]
+
+
+def test_results_are_in_subgroup(kernel):
+    for i in range(4):
+        p = B.hash_to_g2(b"subgroup-%d" % i)
+        assert B.g2_on_curve(p) and B.g2_in_subgroup(p)
+
+
+def test_env_kill_switch_forces_pure_path():
+    """HBBFT_TPU_NO_NATIVE_HASHG2 must disable the kernel (and the pure
+    path alone must still serve hash_to_g2) — checked in a subprocess
+    because the loader caches its decision at first use."""
+    code = (
+        "import hbbft_tpu.crypto.bls381 as B\n"
+        "from hbbft_tpu import native\n"
+        "p = B.hash_to_g2(b'kill-switch')\n"
+        "assert native._hg2_lib is None\n"
+        "assert p == B._hash_to_g2_pure(b'kill-switch')\n"
+        "print('pure-only OK')\n"
+    )
+    env = dict(os.environ)
+    env["HBBFT_TPU_NO_NATIVE_HASHG2"] = "1"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        env=env, capture_output=True, text=True, cwd=_REPO, timeout=300,
+    )
+    assert proc.returncode == 0 and "pure-only OK" in proc.stdout, (
+        proc.stdout[-500:], proc.stderr[-1000:]
+    )

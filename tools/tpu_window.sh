@@ -118,10 +118,10 @@ done_driver_budget() {
 
 # --- step bodies ------------------------------------------------------------
 do_n100() {
-  # churn=0 deliberately: even with the round-5 device-batched DKG the
-  # N=100 era change is ~7.7h of host hash-to-G2 (PERF.md round-5
-  # itemization) — out of scope this round.  Churn evidence comes from
-  # the n16_churn / n32_churn steps below, on the batched DKG path.
+  # churn=0 here: this step banks the epochs/s record first.  Churn
+  # evidence comes from n16_churn / n32_churn (batched DKG + native
+  # hash kernel), and the FULL 10-epoch+churn shape runs LAST as
+  # n100_churn (~1.5 h era change since the native hash landed).
   HBBFT_TPU_FQ_IMPL=rns BENCH_ONLY=array_n100 BENCH_ARRAY_BACKEND=tpu \
     BENCH_ARRAY_EPOCHS=10 BENCH_ARRAY_CHURN=0 \
     timeout 7200 python bench.py
@@ -201,7 +201,21 @@ do_n16_churn() {
     timeout 3600 python bench.py
 }
 
-STEPS="n100 matrix_rns_a matrix_limb_a matrix_rns_b matrix_limb_b n16_churn flips10k n64coin rs_ab n32_churn kernel_levers driver_budget"
+done_n100_churn() {
+  has_row "$ART/rows_after_n100_churn.json" array_epochs_per_sec_n100 \
+    backend=TpuBackend n=100 churn_epochs=1
+}
+do_n100_churn() {
+  # the FULL north-star shape (VERDICT r4 task 1): >=10 epochs + one era
+  # change in ONE row.  Feasible only since the native hash-to-G2 kernel
+  # (1.8 ms/doc) + batched DKG: era change ~1.5 h + 10 epochs — run LAST
+  # so a dying window costs nothing already captured.
+  HBBFT_TPU_FQ_IMPL=rns BENCH_ONLY=array_n100 BENCH_ARRAY_BACKEND=tpu \
+    BENCH_ARRAY_EPOCHS=10 BENCH_ARRAY_CHURN=1 \
+    timeout 18000 python bench.py
+}
+
+STEPS="n100 matrix_rns_a matrix_limb_a matrix_rns_b matrix_limb_b n16_churn flips10k n64coin rs_ab n32_churn kernel_levers driver_budget n100_churn"
 
 for s in $STEPS; do
   if "done_$s"; then
