@@ -283,17 +283,29 @@ static void fq2_conj(fq2 *r, const fq2 *a) {
     fq_neg(&r->c1, &a->c1);
 }
 static void fq2_mul(fq2 *r, const fq2 *a, const fq2 *b) {
-    fq t0, t1, t2, t3, o0, o1;
-    fq_mul(&t0, &a->c0, &b->c0);
-    fq_mul(&t1, &a->c1, &b->c1);
-    fq_mul(&t2, &a->c0, &b->c1);
-    fq_mul(&t3, &a->c1, &b->c0);
-    fq_sub(&o0, &t0, &t1);
-    fq_add(&o1, &t2, &t3);
+    /* Karatsuba: 3 Fq muls.  (a0b0 - a1b1, (a0+a1)(b0+b1) - a0b0 - a1b1) */
+    fq m0, m1, sa, sb, cross, o0, o1;
+    fq_mul(&m0, &a->c0, &b->c0);
+    fq_mul(&m1, &a->c1, &b->c1);
+    fq_add(&sa, &a->c0, &a->c1);
+    fq_add(&sb, &b->c0, &b->c1);
+    fq_mul(&cross, &sa, &sb);
+    fq_sub(&o0, &m0, &m1);
+    fq_sub(&cross, &cross, &m0);
+    fq_sub(&o1, &cross, &m1);
     r->c0 = o0;
     r->c1 = o1;
 }
-static void fq2_sqr(fq2 *r, const fq2 *a) { fq2_mul(r, a, a); }
+static void fq2_sqr(fq2 *r, const fq2 *a) {
+    /* complex squaring: 2 Fq muls.  ((a0+a1)(a0-a1), 2 a0 a1) */
+    fq s, d, m, o1;
+    fq_add(&s, &a->c0, &a->c1);
+    fq_sub(&d, &a->c0, &a->c1);
+    fq_mul(&m, &a->c1, &a->c0);
+    fq_add(&o1, &m, &m);
+    fq_mul(&r->c0, &s, &d);
+    r->c1 = o1;
+}
 
 static int fq2_is_zero(const fq2 *a) {
     return fq_is_zero(&a->c0) && fq_is_zero(&a->c1);
@@ -476,17 +488,24 @@ static void g2_mul_u_signed(g2j *r, const g2j *p) {
 }
 
 /* psi(x, y) = (cx * conj(x), cy * conj(y)); jacobian: conj(Z) rides along */
+static fq2 PSI_CX_M, PSI_CY_M;
+
+/* dlopen-time init: no lazy flag, no data race — ctypes releases the GIL
+ * during calls, so concurrent hashers must never observe a torn constant */
+__attribute__((constructor)) static void psi_init(void) {
+    fq_set_zero(&PSI_CX_M.c0);
+    fq_from_canon(&PSI_CX_M.c1, PSI_CX_1);
+    fq_from_canon(&PSI_CY_M.c0, PSI_CY_0);
+    fq_from_canon(&PSI_CY_M.c1, PSI_CY_1);
+}
+
 static void g2_psi(g2j *r, const g2j *p) {
     if (p->inf) { g2_set_inf(r); return; }
-    fq2 cx, cy, t;
-    fq_set_zero(&cx.c0);
-    fq_from_canon(&cx.c1, PSI_CX_1);
-    fq_from_canon(&cy.c0, PSI_CY_0);
-    fq_from_canon(&cy.c1, PSI_CY_1);
+    fq2 t;
     fq2_conj(&t, &p->X);
-    fq2_mul(&r->X, &cx, &t);
+    fq2_mul(&r->X, &PSI_CX_M, &t);
     fq2_conj(&t, &p->Y);
-    fq2_mul(&r->Y, &cy, &t);
+    fq2_mul(&r->Y, &PSI_CY_M, &t);
     fq2_conj(&r->Z, &p->Z);
     r->inf = 0;
 }
