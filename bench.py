@@ -344,6 +344,14 @@ def bench_coin_e2e() -> dict:
     f = (n - 1) // 3  # 21
     flips = _env_int("BENCH_COIN_FLIPS", 128)
     iters = _env_int("BENCH_COIN_ITERS", 1)
+    # Fixed per-dispatch chunk: the sign graph holds chunk*n G2 ladder
+    # lanes, so a 10k-flip run (config 2 at size) must NOT compile a
+    # 640k-lane graph — the relay's compile helper 500s on it (observed
+    # 2026-08-01).  500 flips * 64 = 32k lanes = the device lane cap;
+    # larger totals loop the same compiled chunk.
+    chunk = min(flips, _env_int("BENCH_COIN_CHUNK", 500))
+    n_chunks = -(-flips // chunk)  # ceil: never under-run the request
+    flips_total = chunk * n_chunks  # rounded UP to whole chunks; reported
 
     g = CpuBackend().group
     rng = random.Random(21)
@@ -365,31 +373,31 @@ def bench_coin_e2e() -> dict:
     rlc_fn = _jitted_rlc_sig()
     comb_fn = _jitted_combine_g2_batch()
     neg_g1 = pairing.g1_affine_to_device(
-        [gold.ec_neg(gold.FQ, gold.G1_GEN)] * flips
+        [gold.ec_neg(gold.FQ, gold.G1_GEN)] * chunk
     )
-    PK_jac = curve.g1_to_device(pk_els * flips)
+    PK_jac = curve.g1_to_device(pk_els * chunk)
     PK_jac = jax.tree_util.tree_map(
-        lambda c: c.reshape((flips, n) + c.shape[1:]), PK_jac
+        lambda c: c.reshape((chunk, n) + c.shape[1:]), PK_jac
     )
 
-    def flip_batch(epoch_tag: int):
+    def flip_chunk(epoch_tag: int):
         # one distinct doc per flip (the real coin's per-instance nonce);
         # host hash-to-G2 is part of the honest pipeline cost.
         docs = [
-            b"coin:%d:%d" % (epoch_tag, i) for i in range(flips)
+            b"coin:%d:%d" % (epoch_tag, i) for i in range(chunk)
         ]
         H = [g.hash_to_g2(d) for d in docs]
         H_rep = [h for h in H for _ in range(n)]  # sign points, flip-major
-        bits = np.tile(sk_bits_1, (flips, 1))
-        negs = np.tile(sk_negs_1, flips)
+        bits = np.tile(sk_bits_1, (chunk, 1))
+        negs = np.tile(sk_negs_1, chunk)
         S = sign_fn(
             curve.g2_to_device(H_rep), jnp.asarray(bits), jnp.asarray(negs)
-        )  # (flips*n,) signature shares, Jacobian
+        )  # (chunk*n,) signature shares, Jacobian
         S_g = jax.tree_util.tree_map(
-            lambda c: c.reshape((flips, n) + c.shape[1:]), S
+            lambda c: c.reshape((chunk, n) + c.shape[1:]), S
         )
         # grouped-RLC verify: one group per flip
-        rs = [TpuBackend._rlc_scalars(n) for _ in range(flips)]
+        rs = [TpuBackend._rlc_scalars(n) for _ in range(chunk)]
         rbits = jnp.asarray(
             np.stack(
                 [curve.scalars_to_bits(r, TpuBackend._rlc_bits()) for r in rs]
@@ -400,17 +408,17 @@ def bench_coin_e2e() -> dict:
         fvals = jax.tree_util.tree_map(np.asarray, fvals)
         # combine f+1 shares per flip (lowest indices), then parity
         S_k = jax.tree_util.tree_map(lambda c: c[:, :k], S_g)
-        cb = jnp.asarray(np.tile(lam_bits, (flips, 1, 1)))
-        cn = jnp.asarray(np.tile(lam_negs, (flips, 1)))
+        cb = jnp.asarray(np.tile(lam_bits, (chunk, 1, 1)))
+        cn = jnp.asarray(np.tile(lam_negs, (chunk, 1)))
         combined = comb_fn(S_k, cb, cn)
         els = curve.g2_from_device(_squeeze_point(combined))
         bits_out = []
-        for i in range(flips):
+        for i in range(chunk):
             assert pairing.is_one_host(fvals, i), "coin share group failed"
             bits_out.append(Signature(g, els[i]).parity())
         return docs, bits_out
 
-    docs, bits_out = flip_batch(0)  # warm + correctness
+    docs, bits_out = flip_chunk(0)  # warm + correctness
     # golden: host combine of flip 0 must yield the same coin bit
     gold_shares = {
         i: SignatureShare(g, g.g2_mul(shares_sk[i].x, g.hash_to_g2(docs[0])))
@@ -422,19 +430,21 @@ def bench_coin_e2e() -> dict:
 
     t0 = time.perf_counter()
     for it in range(iters):
-        flip_batch(1 + it)
+        for c in range(n_chunks):
+            flip_chunk(1 + it * n_chunks + c)
     dt = (time.perf_counter() - t0) / iters
 
     # single-core estimate: N G2 signs (~1.5ms) + N pairing verifies
     # (~1ms) + combine ≈ 0.16 s/flip ≈ 6 flips/s.
-    fps = flips / dt
+    fps = flips_total / dt
     return {
         "metric": "coin_flips_per_sec",
         "value": round(fps, 2),
         "unit": "flips/s",
         "vs_baseline": round(fps / 6.0, 3),
         "baseline": "estimated",
-        "flips": flips,
+        "flips": flips_total,
+        "chunk": chunk,
         "n": n,
         "signs_per_flip": n,
         "verifies_per_flip": n,

@@ -308,50 +308,99 @@ def g2_to_device(points):
     )
 
 
+def _batch_modinv(vals: List[int], q: int) -> List[int]:
+    """Montgomery batch inversion: one pow(·, -1, q) + 3 bigint muls per
+    element instead of one pow per element.  Zero entries pass through as
+    zero (callers treat them as infinity)."""
+    prefix: List[int] = []
+    acc = 1
+    for v in vals:
+        prefix.append(acc)
+        if v:
+            acc = acc * v % q
+    inv = pow(acc, -1, q)
+    out = [0] * len(vals)
+    for i in range(len(vals) - 1, -1, -1):
+        if vals[i]:
+            out[i] = prefix[i] * inv % q
+            inv = inv * vals[i] % q
+    return out
+
+
 def g1_from_device(P) -> List[Optional[Tuple[int, int]]]:
-    """Batched Jacobian G1 → affine int tuples (host; exact)."""
+    """Batched Jacobian G1 → affine int tuples (host; exact).
+
+    Round-5 vectorization: ONE batched residue readback per coordinate
+    plane (fq.to_ints) and ONE batch inversion for every lane's Z — the
+    per-lane to_int/pow loop was the dominant host cost of macro runs
+    (PERF.md round-5 north-star attribution)."""
     X, Y, Z, inf = P
     X, Y, Z = np.asarray(X), np.asarray(Y), np.asarray(Z)
     inf = np.asarray(inf)
     from hbbft_tpu.crypto.field import Q
 
+    xs = fq.to_ints(X)
+    ys = fq.to_ints(Y)
+    zs = fq.to_ints(Z)
+    zis = _batch_modinv(zs, Q)
     out: List[Optional[Tuple[int, int]]] = []
     for i in range(X.shape[0]):
-        if inf[i]:
+        if inf[i] or zs[i] == 0:
             out.append(None)
             continue
-        z = fq.to_int(Z[i])
-        if z == 0:
-            out.append(None)
-            continue
-        zi = pow(z, -1, Q)
-        x = (fq.to_int(X[i]) * zi * zi) % Q
-        y = (fq.to_int(Y[i]) * zi * zi * zi) % Q
-        out.append((x, y))
+        zi = zis[i]
+        zi2 = zi * zi % Q
+        out.append((xs[i] * zi2 % Q, ys[i] * zi2 * zi % Q))
     return out
 
 
 def g2_from_device(P):
-    """Batched Jacobian G2 → affine ((x0,x1),(y0,y1)) tuples (host; exact)."""
-    from hbbft_tpu.crypto import bls381 as gold
+    """Batched Jacobian G2 → affine ((x0,x1),(y0,y1)) tuples (host; exact).
+
+    Same vectorization as g1_from_device; the Fq2 inversion uses the
+    conjugate/norm identity so the batch inversion runs over Fq norms."""
+    from hbbft_tpu.crypto.field import Q
 
     X, Y, Z, inf = P
     inf = np.asarray(inf)
+    n = np.asarray(X[0]).shape[0]
+    coords = {}
+    for name, pair in (("x", X), ("y", Y), ("z", Z)):
+        coords[name + "0"] = fq.to_ints(np.asarray(pair[0]))
+        coords[name + "1"] = fq.to_ints(np.asarray(pair[1]))
+    # 1/(z0 + z1·u) = (z0 - z1·u) / (z0² + z1²): batch-invert the norms
+    norms = [
+        (coords["z0"][i] * coords["z0"][i] + coords["z1"][i] * coords["z1"][i]) % Q
+        for i in range(n)
+    ]
+    ninvs = _batch_modinv(norms, Q)
     out = []
-    for i in range(np.asarray(X[0]).shape[0]):
-        if inf[i]:
+    for i in range(n):
+        z0, z1 = coords["z0"][i], coords["z1"][i]
+        if inf[i] or (z0 == 0 and z1 == 0):
             out.append(None)
             continue
-        z = tower.fq2_to_ints(Z, i)
-        if z == (0, 0):
-            out.append(None)
-            continue
-        zi = gold.fq2_inv(z)
-        zi2 = gold.fq2_sqr(zi)
-        zi3 = gold.fq2_mul(zi2, zi)
-        x = gold.fq2_mul(tower.fq2_to_ints(X, i), zi2)
-        y = gold.fq2_mul(tower.fq2_to_ints(Y, i), zi3)
-        out.append((x, y))
+        ni = ninvs[i]
+        zi = (z0 * ni % Q, (-z1 * ni) % Q)
+        zi2 = ((zi[0] * zi[0] - zi[1] * zi[1]) % Q, 2 * zi[0] * zi[1] % Q)
+        zi3 = (
+            (zi2[0] * zi[0] - zi2[1] * zi[1]) % Q,
+            (zi2[0] * zi[1] + zi2[1] * zi[0]) % Q,
+        )
+        x0, x1 = coords["x0"][i], coords["x1"][i]
+        y0, y1 = coords["y0"][i], coords["y1"][i]
+        out.append(
+            (
+                (
+                    (x0 * zi2[0] - x1 * zi2[1]) % Q,
+                    (x0 * zi2[1] + x1 * zi2[0]) % Q,
+                ),
+                (
+                    (y0 * zi3[0] - y1 * zi3[1]) % Q,
+                    (y0 * zi3[1] + y1 * zi3[0]) % Q,
+                ),
+            )
+        )
     return out
 
 
