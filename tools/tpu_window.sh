@@ -135,8 +135,11 @@ do_flips10k() {
     timeout 3600 python bench.py
 }
 do_n64coin() {
+  # epochs=1 for the first-ever on-chip capture: the 2-epoch default ran
+  # >30 min into the 13:03 tunnel death (n64 coin macro is host-heavy on
+  # this 1-core box); widen the timeout for the retry
   HBBFT_TPU_FQ_IMPL=rns BENCH_ONLY=array_n64_coin BENCH_COIN_MACRO_BACKEND=tpu \
-    timeout 1800 python bench.py
+    BENCH_COIN_MACRO_EPOCHS=1 timeout 3600 python bench.py
 }
 do_rs_ab() {
   BENCH_ONLY=rs_encode timeout 900 python bench.py
