@@ -27,9 +27,11 @@ load, entries ≤ 63) and the 11-bit digit vectors (split in-kernel into a
 6-bit lo / 5-bit hi plane) are bf16-representable integers, products
 accumulate in f32 (exact: 40 terms of ≤ 63·63 < 2^18), and the weighted
 recombination reduces the hi partials before scaling so every sum stays
-under the 2^24 f32-exact envelope:
+inside the 2^24 f32-exact envelope (round-5 form: the mid partial is
+LOOSE-reduced to (−p, 2p), see _split_dot's bound derivation):
 
-    ll + 64·mod(lh + hl) + 4096·mod(hh)  ≤  155k + 131k + 8.39M  <  2^24
+    ll + 64·loose(lh + hl) + 4096·mod(hh)
+        ∈ (−262k, 159k + 262k + 8.39M) ⊂ (−2^24, 2^24)
 
 This sidesteps any reliance on Mosaic's f32-dot precision lowering — the
 operands ARE bf16, exactly (the fq_rns.py:293 "bit-plane split" lever).
@@ -174,13 +176,27 @@ def _mod_lanes(x, p, invp):
     return x
 
 
-def _split_dot(elo, ehi, v, p, invp):
+def _split_dot(elo, ehi, v, p, invp, exact: bool = True):
     """mod-p rows of Eᵀ·v via four exact bf16 MXU passes.
 
     v is an 11-bit digit block (40, T) in [0, p): split into a 6-bit lo
     and 5-bit hi plane, multiply against the pre-split matrix planes, and
     recombine with the hi partials reduced first (bounds in the module
-    docstring)."""
+    docstring).
+
+    Round-5 op-count trims (every _mod_lanes is ~4 more VPU lane-ops
+    per row than _mod_loose):
+    * ``mid`` needs only the LOOSE reduction: |64·mid_loose| < 64·2p =
+      262,016, and ll + 64·mid + 4096·hh then spans
+      (−262,016, 158,760 + 262,016 + 8,384,512) ⊂ (−2^24, 2^24) — still
+      f32-exact.  (``hh`` must stay exact: 4096·2p would already be
+      16.77M ≈ 2^24.)
+    * ``exact=False`` callers (the SECOND extension) take a loose
+      result in (−p, 2p): its m_r digit row is re-reduced exactly by
+      the S-K delta step, and its r1 consumer is a _mod_loose over
+      |raw − 39·p| — f32-safe at 41p ≪ 2^24.  The FIRST extension's
+      q̂ must stay exact: its consumer bound 3p² + q̂·p ≤ 4p² is tight
+      (the import-time assert in fq_rns.py)."""
     v_hi = jnp.floor(v * (1.0 / 64.0))
     v_lo = v - 64.0 * v_hi
     f32 = DTYPE
@@ -194,9 +210,10 @@ def _split_dot(elo, ehi, v, p, invp):
         )
 
     ll = dot(elo, v_lo)
-    mid = _mod_lanes(dot(elo, v_hi) + dot(ehi, v_lo), p, invp)
+    mid = _mod_loose(dot(elo, v_hi) + dot(ehi, v_lo), p, invp)
     hh = _mod_lanes(dot(ehi, v_hi), p, invp)
-    return _mod_lanes(ll + 64.0 * mid + 4096.0 * hh, p, invp)
+    out = ll + 64.0 * mid + 4096.0 * hh
+    return _mod_lanes(out, p, invp) if exact else _mod_loose(out, p, invp)
 
 
 def _mul_core(a, b, em, cm, reduced: bool):
@@ -224,7 +241,10 @@ def _mul_core(a, b, em, cm, reduced: bool):
     r2r = _mod_loose(x2r * cm[40:, 4:5] + qhat * cm[40:, 5:6], p2r, ip2r)
 
     xi = _mod_lanes(r2r * cm[40:, 6:7], p2r, ip2r)
-    raw = _split_dot(em[40:, :40], em[40:, 40:], xi, cm[:40, 7:8], cm[:40, 8:9])
+    raw = _split_dot(
+        em[40:, :40], em[40:, 40:], xi, cm[:40, 7:8], cm[:40, 8:9],
+        exact=False,
+    )
 
     delta = _mod_lanes(
         (raw[39:40] - r2r[39:40]) * _M2INV_R, _MR, 1.0 / _MR
