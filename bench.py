@@ -1291,7 +1291,7 @@ def bench_array_engine_n100_tpu() -> dict:
 _BENCH_EST_S = {
     "rlc_dec": 180, "share_verify": 150, "rlc_sig": 150, "g2_sign": 150,
     "coin_e2e": 240, "rlc_dec_adversarial": 150, "array_n16_tpu": 420,
-    "array_n100_tpu": 2400, "rs_encode": 120, "rs_host": 60,
+    "array_n100_tpu": 1200, "rs_encode": 120, "rs_host": 60,
     "fq_kernel": 240, "n4": 60, "n4_realcrypto": 300, "n100": 420,
     "array_n256_soak": 300, "array_n100_dedup": 120, "array_n64_coin": 240,
     "array_n100": 300,
@@ -1461,7 +1461,7 @@ def main() -> None:
             # fill ~70% of what's left (compile + warm epoch eat the
             # rest), floor 1, cap at the env/default.  Per-epoch cost
             # from the round-5 step-4 on-chip capture (_BENCH_EST_S).
-            per_epoch = float(os.environ.get("BENCH_N100_TPU_EPOCH_EST", "450"))
+            per_epoch = float(os.environ.get("BENCH_N100_TPU_EPOCH_EST", "250"))
             fit = int((budget - elapsed) * 0.7 / per_epoch)
             if fit < 1:
                 sink.emit(
