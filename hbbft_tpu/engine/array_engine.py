@@ -188,12 +188,26 @@ class ArrayHoneyBadgerNet:
         # honey_badger.py propose(): canonical-encode the contribution
         # (wrapped in DHB's internal envelope in dynamic mode), then
         # threshold-encrypt.
-        cts: Dict[Any, Any] = {}
+        msgs: List[bytes] = []
         for nid in self.ids:
             inner: Any = bytes(contributions[nid])
             if self.dynamic:
                 inner = ("icontrib", inner, [], [])  # lists: match DHB propose()
-            cts[nid] = self.pk_master.encrypt(canonical.encode(inner), self.rng)
+            msgs.append(canonical.encode(inner))
+        # all N threshold-encryptions through the backend's batched
+        # ladders (same math as pk_master.encrypt per node — ~0.85
+        # s/epoch of sequential host EC at N=16, ~5 s at N=100,
+        # measured round-5 profile)
+        from hbbft_tpu.engine.dkg_batch import batched_encrypt
+
+        master_el = self.pk_master.el
+        ct_list = batched_encrypt(self.backend, [master_el] * n, msgs, self.rng)
+        for ct in ct_list:
+            # receivers must pay their own hash-to-G2 in rounds 7-8
+            # (the encryptor-side cache would make them free cache hits)
+            if hasattr(ct, "_hash_point"):
+                del ct._hash_point
+        cts: Dict[Any, Any] = dict(zip(self.ids, ct_list))
         ct_bytes = {nid: cts[nid].to_bytes() for nid in self.ids}
 
         # broadcast.py broadcast(): frame, shard, commit.

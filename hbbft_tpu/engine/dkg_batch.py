@@ -76,11 +76,19 @@ class DkgStats:
         self.msm_terms = 0
 
 
-def _batched_encrypt(backend, pk_els, msgs, rng, stats) -> List[Ciphertext]:
-    """Threshold-encrypt msgs[i] to pk_els[i], ladders batched.
+def batched_encrypt(backend, pk_els, msgs, rng, stats=None) -> List[Ciphertext]:
+    """Threshold-encrypt msgs[i] to pk_els[i], ladders batched — the
+    public batched counterpart of crypto/keys.Ciphertext.encrypt (same
+    stages: U = s·G1, pad = H(s·PK), V = msg ⊕ pad, W = s·H2(U‖V)).
+    Used by the DKG phases here and by the array engine's contribution
+    encryption.  ``stats`` (a DkgStats) is optional work accounting.
 
-    Mirrors crypto/keys.Ciphertext.encrypt stage for stage: U = s·G1,
-    pad = H(s·PK), V = msg ⊕ pad, W = s·H2(U‖V)."""
+    The returned ciphertexts carry the ENCRYPTOR's cached hash point;
+    callers whose receivers must honestly pay their own hash-to-G2
+    delete ``_hash_point`` first (as _batched_decrypt and the array
+    engine both do)."""
+    if stats is None:
+        stats = DkgStats()
     g = backend.group
     n = len(msgs)
     ss = [rng.randrange(1, g.r) for _ in range(n)]
@@ -197,7 +205,7 @@ def batched_era_dkg(
             enc_pk.append(pk_els[nid])
             enc_msgs.append(canonical.encode(list(coeffs)))
         row_coeffs.append(per_k)
-    row_cts = _batched_encrypt(backend, enc_pk, enc_msgs, rng, stats)
+    row_cts = batched_encrypt(backend, enc_pk, enc_msgs, rng, stats)
 
     # -- part handling: each node decrypts + checks its row -----------------
     dec_xs = [sk_xs[ids[k]] for _ in range(n) for k in range(n)]
@@ -260,7 +268,7 @@ def batched_era_dkg(
                 enc_msgs2.append(canonical.encode(acc))
             per_a.append(per_k)
         ack_vals.append(per_a)
-    ack_cts = _batched_encrypt(backend, enc_pk2, enc_msgs2, rng, stats)
+    ack_cts = batched_encrypt(backend, enc_pk2, enc_msgs2, rng, stats)
 
     dec_xs2 = [
         sk_xs[ids[k]]

@@ -17,7 +17,7 @@ from hbbft_tpu.crypto.backend import CpuBackend, MockBackend
 from hbbft_tpu.engine import ArrayHoneyBadgerNet
 from hbbft_tpu.engine.dkg_batch import (
     _batched_decrypt,
-    _batched_encrypt,
+    batched_encrypt,
     DkgStats,
     batched_era_dkg,
 )
@@ -94,7 +94,7 @@ def test_batched_decrypt_rejects_tampered_ciphertext():
     x = rng.randrange(1, g.r)
     pk = g.g1_mul(x, g.g1())
     stats = DkgStats()
-    cts = _batched_encrypt(backend, [pk, pk], [b"aaaa", b"bbbb"], rng, stats)
+    cts = batched_encrypt(backend, [pk, pk], [b"aaaa", b"bbbb"], rng, stats)
     cts[1].v = bytes([cts[1].v[0] ^ 1]) + cts[1].v[1:]  # malleate
     with pytest.raises(ValueError, match="invalid ciphertext"):
         _batched_decrypt(backend, cts, [x, x], stats)
