@@ -218,7 +218,7 @@ do_n100_churn() {
     timeout 18000 python bench.py
 }
 
-STEPS="n100 matrix_rns_a matrix_limb_a matrix_rns_b matrix_limb_b n16_churn flips10k n64coin rs_ab n32_churn kernel_levers driver_budget n100_churn"
+STEPS="n100 matrix_rns_a matrix_limb_a matrix_rns_b matrix_limb_b n16_churn flips10k kernel_levers driver_budget rs_ab n32_churn n64coin n100_churn"
 
 for s in $STEPS; do
   if "done_$s"; then
