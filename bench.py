@@ -859,6 +859,7 @@ def _bench_array_engine(
         "device_seconds_sign": 0.0,
         "device_seconds_decrypt": 0.0,
         "device_seconds_dkg": 0.0,
+        "device_seconds_encrypt": 0.0,
     }
     # mid-run only: era changes need a preceding and a following epoch, so
     # indices clamp to [1, epochs-1] and dedupe (epochs < 2 → no churn; the

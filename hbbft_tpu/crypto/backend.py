@@ -176,7 +176,9 @@ class CryptoBackend(abc.ABC):
         """
         return [sk.decrypt_share_unchecked(ct) for sk, ct in items]
 
-    def g1_mul_batch(self, scalars: Sequence[int], points: Sequence[Any]) -> List[Any]:
+    def g1_mul_batch(
+        self, scalars: Sequence[int], points: Sequence[Any], kind: str = "dkg"
+    ) -> List[Any]:
         """Batched independent G1 scalar multiplications s_i·P_i — the
         primitive the batched era-change DKG (engine/dkg_batch.py) feeds
         with commitment/encryption/decryption ladders.  Device backends
@@ -184,7 +186,9 @@ class CryptoBackend(abc.ABC):
         g = self.group
         return [g.g1_mul(s, p) for s, p in zip(scalars, points)]
 
-    def g2_mul_batch(self, scalars: Sequence[int], points: Sequence[Any]) -> List[Any]:
+    def g2_mul_batch(
+        self, scalars: Sequence[int], points: Sequence[Any], kind: str = "dkg"
+    ) -> List[Any]:
         """Batched independent G2 scalar multiplications (ciphertext W
         components in the batched DKG)."""
         g = self.group

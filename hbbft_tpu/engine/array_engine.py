@@ -201,7 +201,9 @@ class ArrayHoneyBadgerNet:
         from hbbft_tpu.engine.dkg_batch import batched_encrypt
 
         master_el = self.pk_master.el
-        ct_list = batched_encrypt(self.backend, [master_el] * n, msgs, self.rng)
+        ct_list = batched_encrypt(
+            self.backend, [master_el] * n, msgs, self.rng, kind="encrypt"
+        )
         for ct in ct_list:
             # receivers must pay their own hash-to-G2 in rounds 7-8
             # (the encryptor-side cache would make them free cache hits)

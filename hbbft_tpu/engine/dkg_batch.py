@@ -76,7 +76,9 @@ class DkgStats:
         self.msm_terms = 0
 
 
-def batched_encrypt(backend, pk_els, msgs, rng, stats=None) -> List[Ciphertext]:
+def batched_encrypt(
+    backend, pk_els, msgs, rng, stats=None, kind: str = "dkg"
+) -> List[Ciphertext]:
     """Threshold-encrypt msgs[i] to pk_els[i], ladders batched — the
     public batched counterpart of crypto/keys.Ciphertext.encrypt (same
     stages: U = s·G1, pad = H(s·PK), V = msg ⊕ pad, W = s·H2(U‖V)).
@@ -93,8 +95,8 @@ def batched_encrypt(backend, pk_els, msgs, rng, stats=None) -> List[Ciphertext]:
     n = len(msgs)
     ss = [rng.randrange(1, g.r) for _ in range(n)]
     base = [g.g1()] * n
-    us = backend.g1_mul_batch(ss, base)
-    shareds = backend.g1_mul_batch(ss, list(pk_els))
+    us = backend.g1_mul_batch(ss, base, kind)
+    shareds = backend.g1_mul_batch(ss, list(pk_els), kind)
     stats.ladder_muls += 2 * n
     vs = []
     hs = []
@@ -109,7 +111,7 @@ def batched_encrypt(backend, pk_els, msgs, rng, stats=None) -> List[Ciphertext]:
     # verify_ciphertexts would become a free cache hit
     backend.counters.hash_g2_seconds += time.perf_counter() - t0
     stats.hashes_g2 += n
-    ws = backend.g2_mul_batch(ss, hs)
+    ws = backend.g2_mul_batch(ss, hs, kind)
     stats.ladder_muls += n
     out = []
     for i in range(n):

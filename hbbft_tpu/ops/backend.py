@@ -847,10 +847,13 @@ class TpuBackend(CryptoBackend):
             for el in els
         ]
 
-    def g1_mul_batch(self, scalars: Sequence[int], points: Sequence[Any]) -> List[Any]:
-        """Batched independent G1 ladders s_i·P_i for the batched DKG
-        (engine/dkg_batch.py): commitment coefficient muls, ciphertext
-        U/shared components, row/value decrypt ladders.
+    def g1_mul_batch(
+        self, scalars: Sequence[int], points: Sequence[Any], kind: str = "dkg"
+    ) -> List[Any]:
+        """Batched independent G1 ladders s_i·P_i for the batched DKG and
+        batched threshold encryption: commitment coefficient muls,
+        ciphertext U/shared components, row/value decrypt ladders.
+        ``kind`` picks the device-time attribution bucket.
 
         Precondition (as for decrypt_shares_batch): points have order r —
         the DKG feeds generator multiples and honestly-encrypted U values.
@@ -859,23 +862,25 @@ class TpuBackend(CryptoBackend):
             list(scalars),
             list(points),
             lambda i: self.group.g1_mul(scalars[i], points[i]),
-            lambda sub: self.g1_mul_batch(scalars[sub], list(points)[sub]),
+            lambda sub: self.g1_mul_batch(scalars[sub], list(points)[sub], kind),
             curve.g1_to_device,
             curve.g1_from_device,
             _jitted_g1_mul_batch(),
-            kind="dkg",
+            kind=kind,
         )
 
-    def g2_mul_batch(self, scalars: Sequence[int], points: Sequence[Any]) -> List[Any]:
-        """Batched independent G2 ladders (DKG ciphertext W = s·H2(U‖V))."""
+    def g2_mul_batch(
+        self, scalars: Sequence[int], points: Sequence[Any], kind: str = "dkg"
+    ) -> List[Any]:
+        """Batched independent G2 ladders (ciphertext W = s·H2(U‖V))."""
         return self._ladder_batch(
             list(scalars),
             list(points),
             lambda i: self.group.g2_mul(scalars[i], points[i]),
-            lambda sub: self.g2_mul_batch(scalars[sub], list(points)[sub]),
+            lambda sub: self.g2_mul_batch(scalars[sub], list(points)[sub], kind),
             curve.g2_to_device,
             curve.g2_from_device,
             _jitted_g2_mul_batch(),
-            kind="dkg",
+            kind=kind,
         )
 

@@ -57,6 +57,7 @@ class Counters:
     device_seconds_sign: float = 0.0  # batched G2 sign ladders
     device_seconds_decrypt: float = 0.0  # batched G1 decrypt-share ladders
     device_seconds_dkg: float = 0.0  # batched era-change DKG ladders/MSMs
+    device_seconds_encrypt: float = 0.0  # batched threshold-encrypt ladders
 
     def snapshot(self) -> Dict[str, float]:
         return asdict(self)

@@ -285,6 +285,7 @@ def test_device_seconds_attributed_by_kind(backend, keyset):
     c = backend.counters
     kinds = [
         "pairing", "rlc_sig", "rlc_dec", "combine", "sign", "decrypt",
+        "dkg", "encrypt",
     ]
 
     def split():
@@ -312,6 +313,23 @@ def test_device_seconds_attributed_by_kind(backend, keyset):
     backend.combine_signatures(pks, {0: shares[0], 1: shares[1]})
     after = split()
     assert after["combine"] > before["combine"]
+
+    # batched threshold encryption bills the encrypt bucket, not dkg
+    import random as _random
+
+    from hbbft_tpu.engine.dkg_batch import batched_encrypt
+
+    g = backend.group
+    rng2 = _random.Random(8)
+    pk_el = g.g1_mul(rng2.randrange(1, g.r), g.g1())
+    before = after
+    backend.device_combine_threshold = 1  # force ladders onto the backend
+    batched_encrypt(
+        backend, [pk_el] * 3, [b"a1", b"b2", b"c3"], rng2, kind="encrypt"
+    )
+    after = split()
+    assert after["encrypt"] > before["encrypt"]
+    assert after["dkg"] == before["dkg"]
 
     # the kind split accounts for the total: every dispatch site passes a
     # kind, so over this test's operations the kind deltas must EQUAL the
