@@ -1395,6 +1395,12 @@ def _plan_benches(only, platform: str, budget: float) -> list:
         # array_n100_tpu is reachable without a budget only by request
         plan.append(("array_n100_tpu", bench_array_engine_n100_tpu))
     if only is not None:
+        # an explicit request overrides the platform gate (budget branch
+        # only adds the row on tpu)
+        if "array_n100_tpu" in only and "array_n100_tpu" not in {
+            n for n, _ in plan
+        }:
+            plan.append(("array_n100_tpu", bench_array_engine_n100_tpu))
         plan = [(n, f) for (n, f) in plan if n in only]
     else:
         plan = [(n, f) for (n, f) in plan if n != "array_n100_tpu" or budget]

@@ -104,3 +104,30 @@ def test_exhausted_budget_still_writes_labeled_rows(bench, tmp_path):
     assert skipped and all("budget exhausted" in r["skipped"] for r in skipped)
     planned = [n for n, _ in bench._plan_benches(None, "cpu", 1.0)]
     assert {r["metric"] for r in skipped} == set(planned)
+
+
+def test_n100_tpu_adaptive_skip_when_budget_too_small(bench, tmp_path):
+    """The adaptive-epoch branch must SKIP (with a labeled row) when not
+    even one epoch fits the remaining budget — rather than launching a
+    doomed run into the driver's timeout."""
+    rows_path = tmp_path / "rows.json"
+    env = dict(os.environ)
+    env.update(
+        BENCH_BUDGET="2000",
+        BENCH_ONLY="array_n100_tpu",
+        BENCH_N100_TPU_EPOCH_EST="10000",  # one epoch alone exceeds budget
+        BENCH_ROWS_PATH=str(rows_path),
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS="--xla_force_host_platform_device_count=1",
+        BENCH_PLATFORM_CHECKED="1",
+    )
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "bench.py")],
+        env=env, capture_output=True, text=True, cwd=_REPO, timeout=600,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    data = json.loads(rows_path.read_text())
+    (row,) = data["rows"]
+    assert row["metric"] == "array_n100_tpu"
+    assert "budget exhausted" in row["skipped"]
