@@ -1,0 +1,92 @@
+"""Compile a TPU-window snapshot directory into readable tables.
+
+The runbook (tools/tpu_window.sh) copies BENCH_rows.json into
+``rows_after_<step>.json`` after every step; this tool turns that
+directory into (a) a step-by-step metric table and (b) the cross-impl
+matrix (metrics x fq_impl with per-trial values) — the analysis the
+round-5 PERF.md sections were built from, automated for round 6.
+
+    python tools/analyze_window.py [tpu_window_r05]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from collections import defaultdict
+
+
+def load_rows(art_dir: str):
+    out = []
+    for name in sorted(os.listdir(art_dir)):
+        if not (name.startswith("rows_after_") and name.endswith(".json")):
+            continue
+        step = name[len("rows_after_") : -len(".json")]
+        try:
+            with open(os.path.join(art_dir, name)) as f:
+                data = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"!! {name}: unreadable ({e})")
+            continue
+        for row in data.get("rows", []):
+            out.append((step, data.get("meta", {}), row))
+    return out
+
+
+def main() -> None:
+    art = sys.argv[1] if len(sys.argv) > 1 else "tpu_window_r05"
+    rows = load_rows(art)
+    if not rows:
+        print(f"no snapshots under {art}/")
+        return
+
+    print(f"== {art}: step-by-step ==")
+    for step, meta, row in rows:
+        metric = row.get("metric", "?")
+        if "value" in row:
+            extras = " ".join(
+                f"{k}={row[k]}"
+                for k in ("n", "epochs", "churn_epochs", "flips", "fq_impl",
+                          "backend", "era_change_seconds", "row_seconds")
+                if k in row
+            )
+            print(f"{step:22s} {metric:38s} {row['value']:>12} "
+                  f"{row.get('unit', ''):12s} {extras}")
+        else:
+            why = row.get("error") or row.get("skipped") or "?"
+            print(f"{step:22s} {metric:38s} {'—':>12} FAILED: {str(why)[:60]}")
+
+    # cross-impl matrix over the matrix_* trials
+    matrix = defaultdict(dict)  # metric -> trial -> value
+    for step, meta, row in rows:
+        if step.startswith("matrix_") and "value" in row:
+            matrix[row["metric"]][step[len("matrix_"):]] = row["value"]
+    if matrix:
+        trials = sorted({t for m in matrix.values() for t in m})
+        print(f"\n== cross-impl matrix ==")
+        print(f"{'metric':38s}" + "".join(f"{t:>12s}" for t in trials))
+        for metric, per in sorted(matrix.items()):
+            print(f"{metric:38s}" + "".join(
+                f"{per.get(t, float('nan')):>12.1f}" for t in trials))
+
+    # device-time attribution for macro rows that carry it
+    print("\n== macro attribution (s/epoch) ==")
+    for step, meta, row in rows:
+        if "device_seconds_per_epoch" not in row:
+            continue
+        kinds = {
+            k[len("device_seconds_"):-len("_per_epoch")]: v
+            for k, v in row.items()
+            if k.startswith("device_seconds_") and k.endswith("_per_epoch")
+            and k != "device_seconds_per_epoch"
+        }
+        total = 1.0 / row["value"] if row.get("value") else float("nan")
+        print(f"{step}: n={row.get('n')} total={total:.1f} "
+              f"device={row['device_seconds_per_epoch']} "
+              f"hash={row.get('hash_g2_seconds_per_epoch', 0)} "
+              f"kinds={kinds}")
+
+
+if __name__ == "__main__":
+    main()
