@@ -1,0 +1,41 @@
+"""The window-snapshot analyzer must stay loadable and correct on the
+snapshot format the runbook writes (it is the round-6 judge/EDA path
+over the window artifacts)."""
+
+import json
+import subprocess
+import sys
+import os
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_analyzer_over_synthetic_snapshots(tmp_path):
+    (tmp_path / "rows_after_matrix_rns_a.json").write_text(json.dumps({
+        "meta": {"fq_impl": "rns"},
+        "rows": [{"metric": "rlc_dec_verify_throughput", "value": 17740.8,
+                  "unit": "shares/s", "fq_impl": "rns", "row_seconds": 72.2}],
+    }))
+    (tmp_path / "rows_after_n100.json").write_text(json.dumps({
+        "meta": {},
+        "rows": [{"metric": "array_epochs_per_sec_n100", "value": 0.00464,
+                  "unit": "epochs/s", "n": 100, "epochs": 10,
+                  "device_seconds_per_epoch": 94.89,
+                  "device_seconds_rlc_dec_per_epoch": 55.57,
+                  "hash_g2_seconds_per_epoch": 1.5}],
+    }))
+    (tmp_path / "rows_after_broken.json").write_text(json.dumps({
+        "meta": {},
+        "rows": [{"metric": "coin_e2e", "error": "boom"}],
+    }))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools", "analyze_window.py"),
+         str(tmp_path)],
+        capture_output=True, text=True, cwd=_REPO, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr[-1000:]
+    out = proc.stdout
+    assert "17740.8" in out          # step table value
+    assert "FAILED: boom" in out     # error row surfaced
+    assert "rns_a" in out            # matrix column
+    assert "rlc_dec" in out and "55.57" in out  # attribution kinds
