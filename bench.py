@@ -839,15 +839,26 @@ def _bench_array_engine(
     engine's decrypt-equality asserts are the correctness check."""
     from examples.simulation import make_backend
     from hbbft_tpu.engine import ArrayHoneyBadgerNet
+    from hbbft_tpu.obs import Tracer
 
     backend = make_backend(os.environ.get(backend_env, backend_default))
+    # histogram-only tracer (spans off: no event-list growth on
+    # million-dispatch epochs): dispatch batch sizes + RLC group sizes
+    # ride the row as p50/p90/p99 summaries
+    tracer = Tracer(spans=False)
+    backend.tracer = tracer
     net = ArrayHoneyBadgerNet(
         range(n), backend=backend, seed=0, dedup_verifies=dedup,
-        dynamic=dynamic, coin_rounds=coin_rounds,
+        dynamic=dynamic, coin_rounds=coin_rounds, tracer=tracer,
     )
     net.run_epochs(1, payload_size=64)  # warm: compile/caches
     counters = getattr(backend, "counters", None)
     ctr0 = counters.snapshot() if counters is not None else {}
+    # post-warm baselines so the row's counters/histograms cover exactly
+    # the timed epochs (the warm epoch includes JIT compilation, which
+    # would skew the attribution the *_per_epoch fields exclude)
+    merged0 = net.counters.merged_with(backend.counters)
+    tracer.histograms.clear()
     churn_ctr = {
         "device_seconds": 0.0,
         "hash_g2_seconds": 0.0,
@@ -875,17 +886,23 @@ def _bench_array_engine(
         else []
     )
     churn_time = 0.0
+    churn_merged = {}
     t0 = time.perf_counter()
     done = 0
     for e in range(epochs):
         if e in churn_at:
             t_ch = time.perf_counter()
             pre = counters.snapshot() if counters is not None else {}
+            pre_merged = net.counters.merged_with(backend.counters)
             net.era_change()
             if counters is not None:
                 d = counters.diff(pre)
                 for k in churn_ctr:  # excluded like churn_time is
                     churn_ctr[k] += d.get(k, 0.0)
+            for k, v in net.counters.merged_with(backend.counters).items():
+                dv = v - pre_merged.get(k, 0)
+                if dv:
+                    churn_merged[k] = churn_merged.get(k, 0) + dv
             churn_time += time.perf_counter() - t_ch
         net.run_epochs(1, payload_size=64)
         done += 1
@@ -928,6 +945,25 @@ def _bench_array_engine(
         row["era_change_seconds"] = round(churn_time / len(net.churn_reports), 3)
         row["era_change_kg_acks"] = crep.kg_acks_handled
         row["era"] = net.era
+    hists = tracer.hist_summary()
+    if hists:
+        row["histograms"] = hists
+    # merged counters delta (engine + crypto, nonzero keys) over the
+    # TIMED steady-state epochs only — era-change work subtracted, like
+    # churn_time and the *_per_epoch fields — so driver artifacts carry
+    # full attribution without a re-run.  (The histograms above still
+    # include era-change dispatches on dynamic configs: distributions
+    # are not subtractable.)
+    merged1 = net.counters.merged_with(backend.counters)
+    row["counters"] = {
+        k: v
+        for k in merged1
+        if (
+            v := round(
+                merged1[k] - merged0.get(k, 0) - churn_merged.get(k, 0), 4
+            )
+        )
+    }
     return row
 
 

@@ -18,16 +18,26 @@ Deferred crypto (CryptoWork) is accumulated and flushed in batches of
 one dispatch — the SURVEY.md §7 round-barrier design in its virtual-time
 form.
 
+Observability (hbbft_tpu/obs): ``--trace PATH`` (or ``HBBFT_TPU_TRACE=
+PATH``) records protocol/device spans + latency histograms and writes a
+Chrome-trace-event/Perfetto ``trace.json`` (``.jsonl`` → raw event
+lines); ``--heartbeat S`` emits a JSON health line every S seconds;
+``--stall-timeout T`` arms the stall detector, which after T seconds
+without progress dumps a why-stalled report naming the blocked BA/RBC
+instances.
+
 Usage:
     python examples/simulation.py -n 10 -f 3 -b 100 --epochs 5
     python examples/simulation.py -n 4 -f 1 --backend cpu   # real BLS, slow
     python examples/simulation.py --backend tpu             # device batches
+    python examples/simulation.py -n 10 -f 3 --engine array --trace trace.json
 """
 
 from __future__ import annotations
 
 import argparse
 import heapq
+import json
 import os
 import pickle
 import random
@@ -41,6 +51,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from hbbft_tpu.core.network_info import NetworkInfo
 from hbbft_tpu.core.types import CryptoWork, Step
 from hbbft_tpu.crypto.backend import CpuBackend, MockBackend
+from hbbft_tpu.obs import HealthReporter, Tracer, why_stalled
 from hbbft_tpu.protocols.queueing_honey_badger import QueueingHoneyBadger
 from hbbft_tpu.protocols.sender_queue import SenderQueue
 
@@ -95,6 +106,10 @@ class Simulation:
         self.delivered = 0
         self._pending_work: List[Tuple[int, CryptoWork]] = []
         self._resumed = False
+        self.faults = 0
+        #: opt-in observability (attached by main() after construction)
+        self.tracer: Optional[Tracer] = None
+        self.health: Optional[HealthReporter] = None
 
     # -- plumbing ------------------------------------------------------------
 
@@ -124,6 +139,8 @@ class Simulation:
 
     def _emit(self, node: SimNode, step: Step) -> None:
         node.outputs.extend(step.output)
+        if step.fault_log.entries:
+            self.faults += len(step.fault_log.entries)
         for work in step.work:
             self._pending_work.append((node.id, work))
         all_ids = self._all_ids
@@ -200,6 +217,9 @@ class Simulation:
         sim.delivered = 0
         sim._pending_work = []
         sim._resumed = False
+        sim.faults = 0
+        sim.tracer = None
+        sim.health = None
         sim.restore(blob)
         return sim
 
@@ -261,10 +281,25 @@ class Simulation:
         rows = []
         done_epochs = min(len(n.outputs) for n in self.nodes.values())
         wall0 = time.perf_counter()
+        tracer = self.tracer
+        t_epoch = wall0
         while done_epochs < target:
             if not self.events:
                 self._flush_work()
                 if not self.events:
+                    # quiesced short of the target: no later tick will
+                    # ever see the stall timeout, so report it NOW —
+                    # this is the state why_stalled names culprits for.
+                    # Only when the stall detector is armed: --heartbeat
+                    # alone must not emit stall records.
+                    if (
+                        self.health is not None
+                        and self.health.stall_timeout_s
+                        and done_epochs < target
+                    ):
+                        self.health.report_quiesced(
+                            epoch=done_epochs, msgs=self.delivered
+                        )
                     break
             burst = 0
             while self.events and burst < a.crypto_window:
@@ -272,13 +307,42 @@ class Simulation:
                 node = self.nodes[to]
                 node.clock = max(node.clock, t) + a.cpu_factor / 1000.0
                 self.delivered += 1
-                step = node.algo.handle_message(frm, payload, rng=self.rng)
+                if tracer is None:
+                    step = node.algo.handle_message(frm, payload, rng=self.rng)
+                else:
+                    t0 = time.perf_counter()
+                    step = node.algo.handle_message(frm, payload, rng=self.rng)
+                    t1 = time.perf_counter()
+                    tracer.hist("crank_latency_us").record((t1 - t0) * 1e6)
+                    if tracer.crank_spans:
+                        tracer.complete(
+                            f"crank:{type(payload).__name__}", t0, t1,
+                            cat="crank", track="crank", to=to,
+                        )
                 self._emit(node, step)
                 burst += 1
             self._flush_work()
+            if tracer is not None:
+                tracer.hist("event_queue_depth").record(len(self.events))
+                h = tracer.hist("sender_queue_depth")
+                for n_ in self.nodes.values():
+                    out = getattr(n_.algo, "_outgoing", None)
+                    if out is not None:
+                        h.record(sum(len(v) for v in out.values()))
+            if self.health is not None:
+                self.health.tick(
+                    epoch=done_epochs, msgs=self.delivered, faults=self.faults
+                )
 
             min_epochs = min(len(n.outputs) for n in self.nodes.values())
             while done_epochs < min_epochs:
+                if tracer is not None:
+                    now = time.perf_counter()
+                    tracer.complete(
+                        f"epoch:{done_epochs}", t_epoch, now, cat="epoch",
+                        epoch=done_epochs,
+                    )
+                    t_epoch = now
                 batch = self.nodes[0].outputs[done_epochs]
                 vtime = max(n.clock for n in self.nodes.values())
                 txns = sum(len(c) for c in getattr(batch, "contributions", {}).values())
@@ -303,7 +367,13 @@ class Simulation:
         return rows
 
 
-def run_array(args, backend, rng: random.Random) -> List[dict]:
+def run_array(
+    args,
+    backend,
+    rng: random.Random,
+    tracer: Optional[Tracer] = None,
+    health: Optional[HealthReporter] = None,
+) -> List[dict]:
     """Drive the lockstep array engine (hbbft_tpu/engine) with the same
     transaction/virtual-time model and produce the same table rows.
 
@@ -351,6 +421,7 @@ def run_array(args, backend, rng: random.Random) -> List[dict]:
             coin_rounds=args.coin_rounds or 0,
             dynamic=bool(churn_at),
         )
+    net.tracer = tracer
     # Tables are PER-RUN (virtual clock, msgs, and the cumulative crypto
     # counters all start at this run's zero — backend counters are
     # environment, not snapshot state); only the epoch INDEX is absolute,
@@ -416,6 +487,8 @@ def run_array(args, backend, rng: random.Random) -> List[dict]:
                 "dispatches": c.device_dispatches,
             }
         )
+        if health is not None:
+            health.tick(epoch=epoch + 1, msgs=delivered)
     if args.checkpoint:
         with open(args.checkpoint, "wb") as fh:
             fh.write(net.checkpoint())
@@ -467,6 +540,29 @@ def main(argv: Optional[List[str]] = None) -> int:
         help="resume from a --checkpoint snapshot; --epochs is the TOTAL "
         "epoch count including pre-checkpoint epochs",
     )
+    p.add_argument(
+        "--trace",
+        metavar="PATH",
+        default=os.environ.get("HBBFT_TPU_TRACE"),
+        help="record spans + histograms; write a Chrome-trace/Perfetto "
+        "JSON (or raw JSONL if PATH ends in .jsonl) here "
+        "(default: $HBBFT_TPU_TRACE)",
+    )
+    p.add_argument(
+        "--crank-spans",
+        action="store_true",
+        help="with --trace on the object engine: one span per delivered "
+        "message (small runs only — large runs fill the event buffer)",
+    )
+    p.add_argument(
+        "--heartbeat", type=float, default=0.0, metavar="S",
+        help="emit a JSON health heartbeat every S wall seconds (0 = off)",
+    )
+    p.add_argument(
+        "--stall-timeout", type=float, default=0.0, metavar="T",
+        help="after T seconds without progress, dump a why-stalled report "
+        "naming the blocked BA/RBC instances (0 = off)",
+    )
     args = p.parse_args(argv)
 
     if args.num_nodes <= 3 * args.num_faulty:
@@ -474,12 +570,25 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     rng = random.Random(args.seed)
     backend = make_backend(args.backend)
+    tracer: Optional[Tracer] = None
+    if args.trace:
+        tracer = Tracer()
+        tracer.crank_spans = args.crank_spans
+        backend.tracer = tracer
+    health: Optional[HealthReporter] = None
+    if args.heartbeat or args.stall_timeout:
+        health = HealthReporter(
+            # --heartbeat 0 means OFF, even with the stall detector armed
+            interval_s=args.heartbeat if args.heartbeat else float("inf"),
+            stall_timeout_s=args.stall_timeout,
+            counters_fn=backend.counters.snapshot,
+        )
     print(
         f"hbbft_tpu simulation: N={args.num_nodes} f={args.num_faulty} "
         f"batch={args.batch_size} backend={args.backend} engine={args.engine}"
     )
     if args.engine == "array":
-        rows = run_array(args, backend, rng)
+        rows = run_array(args, backend, rng, tracer=tracer, health=health)
     else:
         if args.churn_at is not None or args.coin_rounds:
             p.error("--churn-at/--coin-rounds require --engine array")
@@ -488,11 +597,24 @@ def main(argv: Optional[List[str]] = None) -> int:
                 sim = Simulation.from_checkpoint(args, backend, fh.read())
         else:
             sim = Simulation(args, backend, rng)
+        sim.tracer = tracer
+        if health is not None:
+            health.stall_report_fn = lambda: why_stalled(sim.nodes)
+            sim.health = health
         rows = sim.run()
         if args.checkpoint:
             with open(args.checkpoint, "wb") as fh:
                 fh.write(sim.checkpoint())
             print(f"checkpoint written to {args.checkpoint}")
+    if tracer is not None:
+        tracer.write(args.trace)
+        print(
+            f"trace written to {args.trace} "
+            f"({len(tracer)} events, {tracer.dropped} dropped)"
+        )
+        summary = tracer.hist_summary()
+        if summary:
+            print("histograms: " + json.dumps(summary))
     print(
         f"{'epoch':>6} {'virt ms':>10} {'wall s':>8} {'txns':>6} {'msgs':>8} "
         f"{'shr.vrf':>8} {'pairchk':>8} {'shr.cmb':>8} {'disp':>6}"

@@ -26,7 +26,8 @@ Implementations:
 from __future__ import annotations
 
 import abc
-from typing import Any, Dict, List, Optional, Sequence, Tuple
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from hbbft_tpu.crypto.group import Group, MockGroup
 from hbbft_tpu.crypto.keys import (
@@ -51,6 +52,31 @@ class CryptoBackend(abc.ABC):
         #: operative-metric tallies (SURVEY.md §5): shares verified/combined,
         #: pairing checks, device dispatches.
         self.counters = Counters()
+        #: opt-in :class:`~hbbft_tpu.obs.tracer.Tracer`; when attached, the
+        #: batched entry points emit dispatch spans + batch-size histograms
+        #: (host backends span the batched host call; TpuBackend spans the
+        #: actual jitted dispatch+fetch with ``device=True``).
+        self.tracer = None
+
+    def _traced(self, kind: str, n_items: int, fn: Callable[[], Any]) -> Any:
+        """Run one batched backend call under a dispatch span when tracing.
+
+        ``kind`` reuses the ``device_seconds_*`` label vocabulary as the
+        span category.  Zero-cost when no tracer is attached; empty
+        batches (no-op flushes) are not recorded — a flood of items=0
+        samples would drag the batch-size percentiles to zero."""
+        tr = self.tracer
+        if tr is None or not n_items:
+            return fn()
+        t0 = time.perf_counter()
+        out = fn()
+        t1 = time.perf_counter()
+        tr.complete(
+            f"dispatch:{kind}", t0, t1, cat=kind, track="device",
+            items=n_items, device=False,
+        )
+        tr.hist("dispatch_batch_items").record(n_items)
+        return out
 
     # -- key material --------------------------------------------------------
 
@@ -69,10 +95,11 @@ class CryptoBackend(abc.ABC):
         c = self.counters
         c.sig_shares_verified += len(items)
         c.pairing_checks += len(items)
-        out = []
-        for pk, doc, share in items:
-            out.append(pk.verify_sig_share(share, doc))
-        return out
+        return self._traced(
+            "pairing",
+            len(items),
+            lambda: [pk.verify_sig_share(share, doc) for pk, doc, share in items],
+        )
 
     def verify_dec_shares(
         self, items: Sequence[Tuple[PublicKeyShare, Ciphertext, DecryptionShare]]
@@ -81,10 +108,11 @@ class CryptoBackend(abc.ABC):
         c = self.counters
         c.dec_shares_verified += len(items)
         c.pairing_checks += len(items)
-        out = []
-        for pk, ct, share in items:
-            out.append(pk.verify_decryption_share(share, ct))
-        return out
+        return self._traced(
+            "pairing",
+            len(items),
+            lambda: [pk.verify_decryption_share(share, ct) for pk, ct, share in items],
+        )
 
     def verify_signatures(
         self, items: Sequence[Tuple[Any, bytes, Signature]]
@@ -93,12 +121,18 @@ class CryptoBackend(abc.ABC):
         (per-node vote/key-gen signatures — SURVEY.md §3.2 DHB path)."""
         self.counters.signatures_verified += len(items)
         self.counters.pairing_checks += len(items)
-        return [pk.verify(sig, msg) for pk, msg, sig in items]
+        return self._traced(
+            "pairing",
+            len(items),
+            lambda: [pk.verify(sig, msg) for pk, msg, sig in items],
+        )
 
     def verify_ciphertexts(self, items: Sequence[Ciphertext]) -> List[bool]:
         self.counters.ciphertexts_verified += len(items)
         self.counters.pairing_checks += len(items)
-        return [ct.verify() for ct in items]
+        return self._traced(
+            "pairing", len(items), lambda: [ct.verify() for ct in items]
+        )
 
     # -- combination ---------------------------------------------------------
 
@@ -134,10 +168,14 @@ class CryptoBackend(abc.ABC):
         share-combination kernel is BASELINE config 5's "ICI all-gather"
         shape); the default is the per-item loop.
         """
-        return [
-            self.combine_decryption_shares(pk_set, shares, ct)
-            for shares, ct in items
-        ]
+        return self._traced(
+            "combine",
+            len(items),
+            lambda: [
+                self.combine_decryption_shares(pk_set, shares, ct)
+                for shares, ct in items
+            ],
+        )
 
     def sign_shares_batch(
         self, items: Sequence[Tuple[Any, bytes]]
@@ -147,7 +185,11 @@ class CryptoBackend(abc.ABC):
         is one x_i·H2(doc) G2 scalar multiplication; SURVEY.md §3.2 marks
         the coin as the hottest loop).  Device backends override with one
         batched ladder dispatch."""
-        return [sk.sign_share(doc) for sk, doc in items]
+        return self._traced(
+            "sign",
+            len(items),
+            lambda: [sk.sign_share(doc) for sk, doc in items],
+        )
 
     def combine_sig_shares_batch(
         self,
@@ -158,10 +200,14 @@ class CryptoBackend(abc.ABC):
         optional doc for the combined-signature re-verify).  Device
         backends override with a batched G2 Lagrange dispatch; the default
         is the per-item loop."""
-        return [
-            self.combine_signatures(pk_set, shares, doc=doc)
-            for shares, doc in items
-        ]
+        return self._traced(
+            "combine",
+            len(items),
+            lambda: [
+                self.combine_signatures(pk_set, shares, doc=doc)
+                for shares, doc in items
+            ],
+        )
 
     def decrypt_shares_batch(
         self, items: Sequence[Tuple[Any, Ciphertext]]
@@ -174,7 +220,11 @@ class CryptoBackend(abc.ABC):
         node shares every accepted proposer's ciphertext); device backends
         override with one batched ladder dispatch.
         """
-        return [sk.decrypt_share_unchecked(ct) for sk, ct in items]
+        return self._traced(
+            "decrypt",
+            len(items),
+            lambda: [sk.decrypt_share_unchecked(ct) for sk, ct in items],
+        )
 
     def g1_mul_batch(
         self, scalars: Sequence[int], points: Sequence[Any], kind: str = "dkg"
@@ -230,7 +280,13 @@ class MockBackend(CryptoBackend):
         c.pairing_checks += len(items)
         r = self.group.r
         h2 = self.group.hash_to_g2
-        return [share.el % r == (pk.el * h2(doc)) % r for pk, doc, share in items]
+        return self._traced(
+            "pairing",
+            len(items),
+            lambda: [
+                share.el % r == (pk.el * h2(doc)) % r for pk, doc, share in items
+            ],
+        )
 
     def verify_dec_shares(self, items) -> List[bool]:
         # Same equation as PublicKeyShare.verify_decryption_share.
@@ -238,10 +294,14 @@ class MockBackend(CryptoBackend):
         c.dec_shares_verified += len(items)
         c.pairing_checks += len(items)
         r = self.group.r
-        return [
-            (share.el * ct.hash_point()) % r == (pk.el * ct.w) % r
-            for pk, ct, share in items
-        ]
+        return self._traced(
+            "pairing",
+            len(items),
+            lambda: [
+                (share.el * ct.hash_point()) % r == (pk.el * ct.w) % r
+                for pk, ct, share in items
+            ],
+        )
 
 
 class CpuBackend(CryptoBackend):

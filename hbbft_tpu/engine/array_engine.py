@@ -99,6 +99,10 @@ class ArrayHoneyBadgerNet:
     epochs/sec reflect N independent nodes.
     """
 
+    # class-level fallback: snapshots written before the tracer existed
+    # restore without the instance attribute
+    tracer = None
+
     def __init__(
         self,
         node_ids: Sequence[Any],
@@ -108,6 +112,7 @@ class ArrayHoneyBadgerNet:
         verify_chunk: int = 1 << 17,
         dynamic: bool = False,
         coin_rounds: int = 0,
+        tracer=None,
     ) -> None:
         self.ids = sorted(node_ids)
         self.n = len(self.ids)
@@ -140,6 +145,12 @@ class ArrayHoneyBadgerNet:
         self.coin_rounds = coin_rounds
         self.epoch = 0
         self.era = 0
+        #: opt-in :class:`~hbbft_tpu.obs.tracer.Tracer`: run_epoch emits the
+        #: span hierarchy epoch → subset → rbc/ba phases → per-proposer
+        #: RBC/BA instance spans → coin rounds, on top of whatever device
+        #: dispatch spans the backend adds.  Environment, not state —
+        #: checkpoint() detaches it (utils/snapshot.py contract).
+        self.tracer = tracer
         self.counters = Counters()
         self.reports: List[EpochReport] = []
         self.churn_reports: List[EpochReport] = []
@@ -183,6 +194,16 @@ class ArrayHoneyBadgerNet:
         """
         n, f = self.n, self.f
         rep = EpochReport(epoch=self.epoch)
+        tr = self.tracer
+        t_phase = 0.0
+        if tr is not None:
+            tr.begin(
+                f"epoch:{self.epoch}", cat="epoch",
+                epoch=self.epoch, n=n, era=self.era,
+            )
+            tr.begin("subset", cat="subset", epoch=self.epoch)
+            tr.begin("rbc", cat="rbc")
+            t_phase = tr.clock()
 
         # ------ round 0: encrypt + RS-encode + Merkle-commit + Value -------
         # honey_badger.py propose(): canonical-encode the contribution
@@ -281,6 +302,18 @@ class ArrayHoneyBadgerNet:
             assert root == trees[p].root_hash
         for p in self.ids:
             assert values[p] == ct_bytes[p], "RBC value mismatch"
+        if tr is not None:
+            # per-proposer RBC instance spans: in the lockstep schedule all
+            # N instances cover the same wall interval, one per track
+            t_now = tr.clock()
+            for idx, nid in enumerate(self.ids):
+                tr.complete(
+                    f"rbc:{idx}", t_phase, t_now, cat="rbc",
+                    track=f"rbc/{idx}", proposer=repr(nid),
+                )
+            tr.end()  # rbc
+            tr.begin("ba", cat="ba")
+            t_phase = t_now
         # subset.py _on_broadcast_output: input true to BA_p. BA round 0:
         # sbv_broadcast.py send_bval → BVal(true) to all.
         self._count_msgs(rep, n * n * (n - 1))  # BVal
@@ -303,8 +336,24 @@ class ArrayHoneyBadgerNet:
         # split-input schedule where conf_values stays {true, false}).
         for r in range(self.coin_rounds):
             self._coin_round(rep, round_no=r)
+        if tr is not None:
+            # the deciding round consults the FIXED coin (zero-duration
+            # span: no threshold-sign traffic, but the consult is a real
+            # protocol event every BA instance performs)
+            tr.begin(f"coin_round:{self.coin_rounds}", cat="coin", fixed=True)
+            tr.end()
         self._count_msgs(rep, n * n * (n - 1))  # Term
         rep.rounds += 1
+        if tr is not None:
+            t_now = tr.clock()
+            for idx, nid in enumerate(self.ids):
+                tr.complete(
+                    f"ba:{idx}", t_phase, t_now, cat="ba",
+                    track=f"ba/{idx}", proposer=repr(nid),
+                )
+            tr.end()  # ba
+            tr.end()  # subset
+            tr.begin("decrypt", cat="decrypt", epoch=self.epoch)
 
         # ------ round 7: ciphertext validation + decryption shares ---------
         # honey_badger.py: SubsetOutput::Contribution(p, ct) → spawn
@@ -392,6 +441,9 @@ class ArrayHoneyBadgerNet:
             assert tree == bytes(contributions[p]), "decrypt mismatch"
             decoded[p] = tree
         rep.rounds += 1
+        if tr is not None:
+            tr.end()  # decrypt
+            tr.end()  # epoch
 
         batch = Batch(epoch=self.epoch, contributions=decoded)
         self.epoch += 1
@@ -417,6 +469,9 @@ class ArrayHoneyBadgerNet:
 
         All receivers must derive the SAME bit — asserted per instance.
         """
+        tr = self.tracer
+        if tr is not None:
+            tr.begin(f"coin_round:{round_no}", cat="coin", round=round_no)
         n = self.n
         docs = [
             canonical.encode(("coin", self.epoch, p_idx, round_no))
@@ -479,6 +534,8 @@ class ArrayHoneyBadgerNet:
             assert len(bits) == 1, "array engine: coin bit disagreement"
         rep.coin_rounds += 1
         rep.rounds += 1
+        if tr is not None:
+            tr.end()  # coin_round
 
     def era_change(self) -> EpochReport:
         """Mid-run validator turnover: vote → DKG → new era (SURVEY.md
@@ -613,10 +670,15 @@ class ArrayHoneyBadgerNet:
         """Whole-engine state (keys, era, epoch, RNG, reports) to canonical
         snapshot bytes — the soak configs (BASELINE 3/5 at 1k epochs) are
         resumable mid-run.  The crypto backend is environment, not state
-        (utils/snapshot.py contract)."""
+        (utils/snapshot.py contract) — and so is the tracer, detached for
+        the duration of the encode."""
         from hbbft_tpu.utils.snapshot import save_node
 
-        return save_node(self)
+        tr, self.tracer = self.tracer, None
+        try:
+            return save_node(self)
+        finally:
+            self.tracer = tr
 
     @classmethod
     def restore(cls, data: bytes, backend: CryptoBackend) -> "ArrayHoneyBadgerNet":

@@ -16,14 +16,16 @@ scheduling, adversaries, and protocol RNG needs.
 from __future__ import annotations
 
 import random
+import time
 from collections import defaultdict
 from dataclasses import dataclass, field
-from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
 
 from hbbft_tpu.core.network_info import NetworkInfo
 from hbbft_tpu.core.types import CryptoWork, Step, TargetedMessage
 from hbbft_tpu.crypto.backend import CryptoBackend, MockBackend
 from hbbft_tpu.net.adversary import Adversary, NullAdversary
+from hbbft_tpu.obs.tracer import Tracer
 from hbbft_tpu.utils.metrics import Counters, EventLog
 
 
@@ -65,6 +67,7 @@ class VirtualNet:
         defer_mode: str = "eager",
         scheduler: str = "random",
         event_log: Optional["EventLog"] = None,
+        tracer: Optional[Tracer] = None,
     ) -> None:
         self.nodes = nodes
         self.backend = backend
@@ -85,6 +88,13 @@ class VirtualNet:
         self.counters = Counters()
         #: opt-in structured per-crank trace (SURVEY.md §5 port note)
         self.event_log = event_log
+        #: opt-in span tracer + histogram registry (hbbft_tpu/obs).  When
+        #: attached, every crank records its handle_message latency and
+        #: the pre-pop queue depth; per-crank SPANS additionally require
+        #: ``tracer.crank_spans`` (a span per delivered message is only
+        #: worth it on small runs).  Same zero-cost-when-None discipline
+        #: as the event log.
+        self.tracer = tracer
 
     def metrics(self) -> Dict[str, int]:
         """Combined net + crypto counters (one dict, SURVEY.md §5).
@@ -147,7 +157,20 @@ class VirtualNet:
         self.messages_delivered += 1
         if self.message_limit is not None and self.messages_delivered > self.message_limit:
             raise CrankError(f"message limit {self.message_limit} exceeded")
-        step = node.algorithm.handle_message(msg.sender, msg.payload, rng=self.rng)
+        tr = self.tracer
+        if tr is None:
+            step = node.algorithm.handle_message(msg.sender, msg.payload, rng=self.rng)
+        else:
+            tr.hist("net_queue_depth").record(len(self.queue) + 1)
+            t0 = time.perf_counter()
+            step = node.algorithm.handle_message(msg.sender, msg.payload, rng=self.rng)
+            t1 = time.perf_counter()
+            tr.hist("crank_latency_us").record((t1 - t0) * 1e6)
+            if tr.crank_spans:
+                tr.complete(
+                    f"crank:{type(msg.payload).__name__}", t0, t1,
+                    cat="crank", track="crank", to=repr(msg.to),
+                )
         if self.event_log is not None:
             self.event_log.emit(
                 event="crank",
@@ -291,6 +314,7 @@ class NetBuilder:
         self._defer_mode = "eager"
         self._scheduler = "random"
         self._event_log: Optional[EventLog] = None
+        self._tracer: Optional[Tracer] = None
         self._constructor: Optional[Callable[[NetworkInfo, CryptoBackend], Any]] = None
 
     def num_faulty(self, f: int) -> "NetBuilder":
@@ -325,9 +349,16 @@ class NetBuilder:
         self._scheduler = mode
         return self
 
-    def trace(self, event_log: EventLog) -> "NetBuilder":
-        """Attach an opt-in structured per-crank event log."""
-        self._event_log = event_log
+    def trace(self, sink: Union[EventLog, Tracer]) -> "NetBuilder":
+        """Attach an opt-in observability sink: an :class:`EventLog`
+        (structured per-crank events) or an :class:`~hbbft_tpu.obs.tracer
+        .Tracer` (spans + histograms; also attached to the backend so
+        dispatch spans land on the same timeline).  Call twice to attach
+        both."""
+        if isinstance(sink, Tracer):
+            self._tracer = sink
+        else:
+            self._event_log = sink
         return self
 
     def using(
@@ -345,6 +376,8 @@ class NetBuilder:
             raise ValueError("NetBuilder.using(...) not set")
         rng = random.Random(seed)
         backend = self._backend or MockBackend()
+        if self._tracer is not None:
+            backend.tracer = self._tracer
         netinfos = NetworkInfo.generate_map(self._ids, rng, backend)
         faulty_ids = set(rng.sample(self._ids, self._num_faulty))
 
@@ -378,4 +411,5 @@ class NetBuilder:
             defer_mode=self._defer_mode,
             scheduler=self._scheduler,
             event_log=self._event_log,
+            tracer=self._tracer,
         )

@@ -1,0 +1,34 @@
+"""Observability subsystem: span tracing, Perfetto export, histograms,
+and soak-run health/stall reporting.
+
+Three cooperating pieces, all opt-in and zero-cost when absent (the same
+``is None`` discipline :class:`~hbbft_tpu.utils.metrics.EventLog` uses):
+
+* :class:`~hbbft_tpu.obs.tracer.Tracer` — hierarchical begin/end spans on
+  named tracks (epoch → subset → BA instance → coin round → device
+  dispatch), exported as Chrome-trace-event/Perfetto ``trace.json`` or
+  JSONL, plus a registry of log-bucketed :class:`Histogram`\\ s.
+* :class:`~hbbft_tpu.obs.histogram.Histogram` — log-bucketed latency /
+  batch-size distributions with p50/p90/p99 summaries.
+* :class:`~hbbft_tpu.obs.health.HealthReporter` — periodic heartbeat for
+  soak runs and a stall detector whose :func:`~hbbft_tpu.obs.health
+  .why_stalled` report names which BA instances are blocked on which coin
+  rounds and which RBC instances lack Echo/Ready quorum.
+
+Activation: ``NetBuilder.trace(Tracer())`` for the object runtime,
+``ArrayHoneyBadgerNet(..., tracer=...)``/``net.tracer = ...`` for the
+lockstep engine, ``--trace PATH`` / ``HBBFT_TPU_TRACE=PATH`` on
+``examples/simulation.py``.
+"""
+
+from hbbft_tpu.obs.health import HealthReporter, render_why_stalled, why_stalled
+from hbbft_tpu.obs.histogram import Histogram
+from hbbft_tpu.obs.tracer import Tracer
+
+__all__ = [
+    "Tracer",
+    "Histogram",
+    "HealthReporter",
+    "why_stalled",
+    "render_why_stalled",
+]

@@ -239,24 +239,40 @@ class TpuBackend(CryptoBackend):
         Q2 = pairing.g2_affine_to_device([q[3] for q in quads])
 
         f = self._dispatch_fetch(
-            _jitted_product2(), self._place((P1, Q1, P2, Q2)), kind="pairing"
+            _jitted_product2(), self._place((P1, Q1, P2, Q2)), kind="pairing",
+            items=n,
         )
         return [pairing.is_one_host(f, i) for i in range(n)]
 
-    def _dispatch_fetch(self, jitted, args, kind: str = ""):
+    def _dispatch_fetch(self, jitted, args, kind: str = "", items: int = 0):
         """Dispatch one jitted call and fetch the result to host, billing
         the wall clock to counters.device_seconds (task-8 attribution —
         includes any queued device work this fetch must wait for) and,
         when ``kind`` is given, to ``device_seconds_<kind>`` so macro rows
-        can break an epoch's device time down by op kind (r4 task 7)."""
+        can break an epoch's device time down by op kind (r4 task 7).
+
+        With a tracer attached, the identical [t0, t1] interval becomes a
+        ``device=True`` dispatch span on the ``device`` track — traced
+        device time and counter attribution agree exactly by construction
+        (the acceptance check in tools/trace_report.py relies on this)."""
         t0 = time.perf_counter()
         out = jitted(*args)
         out = jax.tree_util.tree_map(np.asarray, out)
-        dt = time.perf_counter() - t0
+        t1 = time.perf_counter()
+        dt = t1 - t0
         self.counters.device_seconds += dt
         if kind:
             name = "device_seconds_" + kind
             setattr(self.counters, name, getattr(self.counters, name) + dt)
+        tr = self.tracer
+        if tr is not None:
+            tr.complete(
+                f"dispatch:{kind or 'unkinded'}", t0, t1,
+                cat=kind or "unkinded", track="device", items=items,
+                device=True,
+            )
+            if items:
+                tr.hist("dispatch_batch_items").record(items)
         return out
 
     # -- grouped (random-linear-combination) verification --------------------
@@ -332,6 +348,11 @@ class TpuBackend(CryptoBackend):
         per-item pairing check.
         """
         pending = [list(grp) for grp in groups if grp]
+        tr = self.tracer
+        if tr is not None:
+            h = tr.hist("rlc_group_size")
+            for grp in pending:
+                h.record(len(grp))
         direct_leaf: List[int] = []
         while pending:
             k = _bucket(max(len(grp) for grp in pending))
@@ -355,7 +376,10 @@ class TpuBackend(CryptoBackend):
             self.counters.device_dispatches += 1
             args = build_group_arrays(padded, g, k)
             placed = self._place(tuple(args) + (jnp.asarray(rbits),))
-            f = self._dispatch_fetch(jitted, placed, kind=kind)
+            f = self._dispatch_fetch(
+                jitted, placed, kind=kind,
+                items=sum(len(grp) for grp in pending),
+            )
             next_pending: List[List[int]] = []
             for gi, grp in enumerate(pending):
                 if pairing.is_one_host(f, gi):
@@ -545,7 +569,8 @@ class TpuBackend(CryptoBackend):
         )
         negs = np.array([n for _, n in safe] + [False] * (b - len(pts)))
         combined = self._dispatch_fetch(
-            jitted, (to_device(points), bits, negs), kind="combine"
+            jitted, (to_device(points), bits, negs), kind="combine",
+            items=len(pts),
         )
         return from_device(combined)[0]
 
@@ -687,7 +712,7 @@ class TpuBackend(CryptoBackend):
         self.counters.device_dispatches += 1
         out = self._dispatch_fetch(
             jitted, self._place((P, jnp.asarray(bits), jnp.asarray(negs))),
-            kind=kind,
+            kind=kind, items=n,
         )
         # from_device's per-lane host affine conversion runs on fetched
         # numpy arrays — host work, deliberately NOT billed as device
@@ -805,7 +830,8 @@ class TpuBackend(CryptoBackend):
         negs = jnp.asarray(np.array(negs_rows))
         self.counters.device_dispatches += 1
         return self._dispatch_fetch(
-            jitted, self._place((P, bits, negs)), kind="combine"
+            jitted, self._place((P, bits, negs)), kind="combine",
+            items=len(share_dicts),
         )
 
     def _combine_sig_chunk(self, pk_set, items, idxs, k, out) -> None:

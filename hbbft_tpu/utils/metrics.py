@@ -16,7 +16,8 @@ check per crank.
 from __future__ import annotations
 
 import json
-from dataclasses import asdict, dataclass, field
+from collections import deque
+from dataclasses import asdict, dataclass, field, fields
 from typing import Any, Dict, List, Optional
 
 
@@ -62,6 +63,11 @@ class Counters:
     def snapshot(self) -> Dict[str, float]:
         return asdict(self)
 
+    def reset(self) -> None:
+        """Zero every tally (fresh measurement window on a shared backend)."""
+        for f in fields(self):
+            setattr(self, f.name, f.default)
+
     def diff(self, prev: Dict[str, float]) -> Dict[str, float]:
         """Delta since a previous :meth:`snapshot` (only nonzero keys)."""
         cur = self.snapshot()
@@ -77,23 +83,24 @@ class EventLog:
 
     Events are plain dicts; ``emit`` is cheap append.  ``to_jsonl`` dumps
     the log for offline analysis.  A ``capacity`` bound (default 1M) guards
-    against unbounded growth on soak runs — oldest events are dropped.
+    against unbounded growth on soak runs — the backing store is a
+    ``deque(maxlen=capacity)`` ring buffer, so eviction of the oldest
+    event is O(1) (the earlier list-based store paid an O(n) front
+    deletion per eviction batch) and ``dropped`` accounting is exact.
     """
 
     def __init__(self, capacity: int = 1_000_000) -> None:
         self.capacity = capacity
-        self.events: List[Dict[str, Any]] = []
-        self._dropped = 0
+        self.events: deque = deque(maxlen=capacity)
+        self._emitted = 0
 
     def emit(self, **fields: Any) -> None:
-        if len(self.events) >= self.capacity:
-            del self.events[: self.capacity // 10]
-            self._dropped += self.capacity // 10
         self.events.append(fields)
+        self._emitted += 1
 
     @property
     def dropped(self) -> int:
-        return self._dropped
+        return self._emitted - len(self.events)
 
     def __len__(self) -> int:
         return len(self.events)

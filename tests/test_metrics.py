@@ -54,8 +54,20 @@ def test_event_log_capacity_bound():
     log = EventLog(capacity=100)
     for i in range(250):
         log.emit(event="x", i=i)
-    assert len(log) <= 100 + 1
-    assert log.dropped > 0
+    # ring buffer: exactly the newest `capacity` events survive, and the
+    # dropped count is exact (the old list store evicted in 10% batches)
+    assert len(log) == 100
+    assert log.dropped == 150
+    assert list(log.events)[0]["i"] == 150  # oldest survivor
+    assert list(log.events)[-1]["i"] == 249
+
+
+def test_counters_reset():
+    c = Counters()
+    c.pairing_checks = 7
+    c.device_seconds = 1.25
+    c.reset()
+    assert c.snapshot() == Counters().snapshot()
 
 
 def test_counters_diff_and_merge():

@@ -1,0 +1,196 @@
+"""HealthReporter + why_stalled tests.
+
+The stall tests are the subsystem's reason to exist: a seeded run whose
+coin (or echo) messages are dropped must produce a why-stalled report
+NAMING the blocked instance and the quorum it lacks.
+"""
+
+import json
+
+from hbbft_tpu.net.virtual_net import NetBuilder
+from hbbft_tpu.obs.health import HealthReporter, render_why_stalled, why_stalled
+from hbbft_tpu.protocols.binary_agreement import BaMessage, BinaryAgreement
+from hbbft_tpu.protocols.broadcast import Broadcast, BroadcastMessage
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+
+# ---------------------------------------------------------------------------
+# heartbeat
+# ---------------------------------------------------------------------------
+
+
+def test_heartbeat_period_rates_and_counter_deltas():
+    clock = _Clock()
+    beats = []
+    counters = {"device_seconds": 0.0, "pairing_checks": 0}
+    hr = HealthReporter(
+        interval_s=10.0,
+        counters_fn=lambda: dict(counters),
+        sink=beats.append,
+        clock=clock,
+    )
+    assert hr.tick(epoch=0, msgs=0) is None  # not due yet
+    clock.t += 10.0
+    counters["device_seconds"] = 2.5
+    counters["pairing_checks"] = 40
+    beat = hr.tick(epoch=1, msgs=500, faults=0)
+    assert beat is not None and beats == [beat]
+    assert beat["heartbeat"] == 1
+    assert beat["epoch"] == 1 and beat["msgs"] == 500
+    assert beat["counters_delta"] == {"device_seconds": 2.5, "pairing_checks": 40}
+    assert beat["device_share"] == 0.25  # 2.5 s device over a 10 s beat
+    clock.t += 10.0
+    beat2 = hr.tick(epoch=2, msgs=1500)
+    assert beat2["msgs_per_s"] == 100.0  # (1500-500)/10
+    assert beat2["counters_delta"] == {}  # nothing moved since beat 1
+    json.dumps(beats)  # heartbeats must be JSON-serializable as emitted
+
+
+def test_stall_fires_once_and_rearms_on_progress():
+    clock = _Clock()
+    records = []
+    hr = HealthReporter(
+        interval_s=1e9,  # heartbeats off
+        stall_timeout_s=30.0,
+        stall_report_fn=lambda: {"nodes": {}, "summary": ["ba blocked"]},
+        sink=records.append,
+        clock=clock,
+    )
+    hr.tick(epoch=0, msgs=10)
+    clock.t += 29.0
+    assert hr.tick(epoch=0, msgs=10) is None  # not yet
+    clock.t += 2.0
+    rec = hr.tick(epoch=0, msgs=10)
+    assert rec is not None and rec["stall"] and hr.stalled
+    assert rec["why"]["summary"] == ["ba blocked"]
+    clock.t += 100.0
+    assert hr.tick(epoch=0, msgs=10) is None  # one-shot per episode
+    # msgs moving is NOT progress when an epoch is supplied: a livelock
+    # (messages churning, no epoch completing) must stay stalled
+    assert hr.tick(epoch=0, msgs=11) is None and hr.stalled
+    rec2 = hr.tick(epoch=1, msgs=11)  # epoch progress re-arms
+    assert rec2 is None and not hr.stalled
+    clock.t += 31.0
+    assert hr.tick(epoch=1, msgs=11)["stall"]
+
+
+def test_stall_msgs_progress_without_epoch():
+    """With no epoch supplied, msgs is the progress signal."""
+    clock = _Clock()
+    records = []
+    hr = HealthReporter(
+        interval_s=1e9,
+        stall_timeout_s=30.0,
+        sink=records.append,
+        clock=clock,
+    )
+    hr.tick(msgs=10)
+    clock.t += 31.0
+    assert hr.tick(msgs=11) is None  # msgs moved: re-armed
+    clock.t += 31.0
+    assert hr.tick(msgs=11)["stall"]
+
+
+# ---------------------------------------------------------------------------
+# why_stalled
+# ---------------------------------------------------------------------------
+
+
+def _drain_without(net, drop, max_cranks=500_000):
+    """Crank to quiescence while dropping messages matching ``drop``."""
+    for _ in range(max_cranks):
+        net.queue[:] = [m for m in net.queue if not drop(m)]
+        if not net.queue:
+            net._flush_work()
+            net.queue[:] = [m for m in net.queue if not drop(m)]
+            if not net.queue:
+                return
+        net.crank()
+    raise AssertionError("did not quiesce")
+
+
+def test_why_stalled_names_ba_blocked_on_coin():
+    """Seeded split-input BA with every coin share dropped: the run
+    quiesces undecided at the first real-coin round (round 2), and the
+    report names the blocked coin round and its share count."""
+    net = (
+        NetBuilder(range(4))
+        .num_faulty(1)
+        .crank_limit(500_000)
+        .using(lambda ni, be: BinaryAgreement(ni, be, session_id=b"stall"))
+        .build(seed=0)  # seed 0: all 4 nodes reach round 2 undecided
+    )
+    for nid in sorted(net.nodes):
+        net.send_input(nid, nid % 2 == 0)  # split inputs: no fast path
+
+    def is_coin(m):
+        return isinstance(m.payload, BaMessage) and m.payload.kind == "coin"
+
+    _drain_without(net, is_coin)
+    assert any(n.algorithm.decision is None for n in net.nodes.values())
+
+    report = why_stalled(net)
+    assert report["summary"], "stalled run must produce a nonempty summary"
+    blocked = [
+        ba
+        for state in report["nodes"].values()
+        for ba in state.get("ba", {}).values()
+    ]
+    assert blocked, "report must name blocked BA instances"
+    coin_blocked = [ba for ba in blocked if ba["blocked_on"] == "coin"]
+    assert coin_blocked, f"expected coin-blocked BA, got {blocked}"
+    for ba in coin_blocked:
+        assert ba["coin_round"] == 2  # first real-coin round (round % 3 == 2)
+        assert ba["coin_shares_verified"] < ba["coin_shares_needed"]
+    text = render_why_stalled(report)
+    assert "blocked on coin round 2" in text
+    json.dumps(report)  # report must be a plain JSON document
+
+
+def test_why_stalled_names_rbc_missing_echo_quorum():
+    net = (
+        NetBuilder(range(4))
+        .num_faulty(1)
+        .crank_limit(100_000)
+        .using(lambda ni, be: Broadcast(ni, proposer_id=0))
+        .build(seed=3)
+    )
+    net.send_input(0, b"payload")
+
+    def is_echo(m):
+        return isinstance(m.payload, BroadcastMessage) and m.payload.kind == "echo"
+
+    _drain_without(net, is_echo)
+    report = why_stalled(net)
+    rbcs = [
+        rbc
+        for state in report["nodes"].values()
+        for rbc in state.get("rbc", {}).values()
+    ]
+    assert rbcs, "undelivered RBC must appear in the report"
+    assert any(r["echoes"] < r["echoes_needed"] for r in rbcs)
+    assert "lacks quorum" in render_why_stalled(report)
+
+
+def test_why_stalled_is_empty_for_a_finished_run():
+    net = (
+        NetBuilder(range(4))
+        .num_faulty(1)
+        .crank_limit(500_000)
+        .using(lambda ni, be: BinaryAgreement(ni, be, session_id=b"done"))
+        .build(seed=1)
+    )
+    for nid in sorted(net.nodes):
+        net.send_input(nid, True)  # unanimous: decides on the fixed coin
+    net.crank_to_quiescence()
+    assert all(n.algorithm.decision is not None for n in net.nodes.values())
+    report = why_stalled(net)
+    assert report["summary"] == [] and report["nodes"] == {}
+    assert "no blocked protocol instances" in render_why_stalled(report)

@@ -1,0 +1,82 @@
+"""Histogram unit tests: bucketing error bound, percentile summaries."""
+
+import random
+
+from hbbft_tpu.obs.histogram import SUBBUCKETS, Histogram
+
+
+def test_empty_histogram_summary():
+    h = Histogram("empty")
+    assert h.summary() == {"count": 0}
+    assert h.percentile(50) == 0.0
+    assert h.mean == 0.0
+    assert len(h) == 0
+
+
+def test_exact_fields_are_exact():
+    h = Histogram()
+    for v in (3.0, 7.0, 1.0, 100.0):
+        h.record(v)
+    assert h.count == 4
+    assert h.min == 1.0
+    assert h.max == 100.0
+    assert h.mean == (3 + 7 + 1 + 100) / 4
+
+
+def test_percentiles_uniform_within_bucket_error():
+    h = Histogram()
+    for v in range(1, 10_001):
+        h.record(float(v))
+    # log-bucket relative error bound: 1/SUBBUCKETS plus the midpoint
+    # placement; 2/SUBBUCKETS is a safe envelope
+    tol = 2.0 / SUBBUCKETS
+    for p, expect in ((50, 5000), (90, 9000), (99, 9900)):
+        got = h.percentile(p)
+        assert abs(got - expect) <= expect * tol, (p, got)
+    s = h.summary()
+    assert s["count"] == 10_000
+    assert s["min"] == 1.0 and s["max"] == 10_000.0
+    assert s["p50"] <= s["p90"] <= s["p99"]
+
+
+def test_percentile_clamps_to_extremes():
+    h = Histogram()
+    h.record(5.0)
+    # single sample: every percentile is that sample
+    assert h.percentile(0) == 5.0
+    assert h.percentile(50) == 5.0
+    assert h.percentile(100) == 5.0
+
+
+def test_subunit_and_power_of_two_values():
+    h = Histogram()
+    vals = [0.001, 0.25, 0.5, 1.0, 2.0, 4.0, 1024.0, 1 << 40]
+    for v in vals:
+        h.record(v)
+    assert h.count == len(vals)
+    assert h.min == 0.001 and h.max == float(1 << 40)
+    # bucket of an exact power of two must not land an octave off
+    for v in (1.0, 2.0, 4.0, 1024.0):
+        b = Histogram._bucket(v)
+        rep = Histogram._bucket_value(b)
+        assert v <= rep <= v * (1.0 + 2.0 / SUBBUCKETS), (v, rep)
+
+
+def test_negative_values_clamp_to_zero():
+    h = Histogram()
+    h.record(-3.0)
+    assert h.count == 1
+    assert h.min == 0.0
+
+
+def test_random_stream_percentile_error_bound():
+    rng = random.Random(7)
+    h = Histogram()
+    samples = sorted(rng.uniform(1.0, 1e6) for _ in range(5000))
+    for v in samples:
+        h.record(v)
+    tol = 2.0 / SUBBUCKETS
+    for p in (50, 90, 99):
+        exact = samples[min(len(samples) - 1, int(len(samples) * p / 100))]
+        got = h.percentile(p)
+        assert abs(got - exact) <= exact * tol + 1e-9, (p, got, exact)

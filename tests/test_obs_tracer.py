@@ -1,0 +1,135 @@
+"""Tracer golden tests: Chrome trace-event schema, span discipline,
+zero-cost disabled mode, export round-trips."""
+
+import json
+
+import pytest
+
+from hbbft_tpu.obs.tracer import Tracer
+from tools.trace_report import (
+    REQUIRED_KEYS,
+    device_span_seconds,
+    load_events,
+    validate_chrome_trace,
+)
+
+
+def _fake_clock(start=100.0, step=0.001):
+    t = [start]
+
+    def clock():
+        t[0] += step
+        return t[0]
+
+    return clock
+
+
+def _sample_tracer():
+    tr = Tracer(clock=_fake_clock())
+    tr.begin("epoch:0", cat="epoch", epoch=0)
+    tr.begin("subset", cat="subset")
+    t0, t1 = tr.clock(), tr.clock()
+    tr.complete("dispatch:pairing", t0, t1, cat="pairing", track="device",
+                items=64, device=True)
+    tr.end()  # subset
+    tr.end()  # epoch
+    tr.hist("dispatch_batch_items").record(64)
+    return tr
+
+
+def test_golden_chrome_trace_schema(tmp_path):
+    tr = _sample_tracer()
+    path = str(tmp_path / "trace.json")
+    tr.write(path)
+    doc = json.load(open(path))
+    assert set(doc) >= {"traceEvents", "displayTimeUnit", "otherData"}
+    events = doc["traceEvents"]
+    # required keys on every event, monotonic ts, matched B/E pairs
+    assert validate_chrome_trace(events) == []
+    for ev in events:
+        assert all(k in ev for k in REQUIRED_KEYS)
+    # thread-name metadata labels every track
+    names = {
+        ev["args"]["name"]
+        for ev in events
+        if ev["ph"] == "M" and ev["name"] == "thread_name"
+    }
+    assert {"main", "device"} <= names
+    # the device dispatch span round-trips with its duration intact
+    assert device_span_seconds(load_events(path)) == pytest.approx(
+        0.001, rel=1e-6
+    )
+    # histograms ride in otherData
+    assert "dispatch_batch_items" in doc["otherData"]["histograms"]
+
+
+def test_spans_nest_and_mismatch_raises():
+    tr = Tracer()
+    with pytest.raises(ValueError):
+        tr.end()  # no open span
+    tr.begin("a")
+    with pytest.raises(ValueError):
+        # retroactive complete may not interleave with an open stack
+        tr.complete("x", 0.0, 1.0)
+    tr.end()
+    tr.complete("x", tr.clock(), tr.clock())  # fine once the stack is empty
+
+
+def test_write_chrome_refuses_open_spans(tmp_path):
+    tr = Tracer()
+    tr.begin("open")
+    with pytest.raises(ValueError):
+        tr.write_chrome(str(tmp_path / "t.json"))
+    tr.end()
+    tr.write_chrome(str(tmp_path / "t.json"))  # closed: fine
+
+
+def test_disabled_spans_are_noops_histograms_live():
+    tr = Tracer(spans=False)
+    tr.begin("a")
+    tr.end()
+    tr.complete("b", 0.0, 1.0)
+    assert len(tr) == 0
+    tr.hist("lat").record(5.0)
+    assert tr.hist_summary()["lat"]["count"] == 1
+
+
+def test_capacity_drops_whole_spans_and_stays_valid(tmp_path):
+    tr = Tracer(capacity=4)
+    for i in range(6):
+        tr.begin(f"s{i}")
+    for _ in range(6):
+        tr.end()
+    # 4 Bs fit; their Es close unconditionally (E of a recorded B is
+    # never dropped — an unclosed span would fail the validator); the 2
+    # overflow spans drop as whole B/E pairs
+    assert len(tr) == 8
+    assert tr.dropped == 4
+    tr.complete("pair", tr.clock(), tr.clock())  # over capacity: drops both
+    assert len(tr) == 8 and tr.dropped == 6
+    path = str(tmp_path / "t.json")
+    tr.write(path)
+    assert validate_chrome_trace(load_events(path)) == []
+
+
+def test_span_context_manager_and_jsonl(tmp_path):
+    tr = Tracer()
+    with tr.span("outer", cat="epoch"):
+        with tr.span("inner"):
+            pass
+    path = str(tmp_path / "trace.jsonl")
+    tr.write(path)
+    lines = [json.loads(line) for line in open(path)]
+    assert [e["ph"] for e in lines] == ["B", "B", "E", "E"]
+    assert lines[0]["name"] == "outer" and lines[1]["name"] == "inner"
+    assert validate_chrome_trace(load_events(path)) == []
+
+
+def test_tracks_get_distinct_tids():
+    tr = Tracer()
+    tr.begin("a", track="main")
+    tr.begin("b", track="ba/0")
+    tr.end(track="ba/0")
+    tr.end(track="main")
+    tids = {ev["tid"] for ev in tr.events}
+    assert len(tids) == 2

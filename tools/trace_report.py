@@ -1,0 +1,258 @@
+"""trace_report — summarize obs traces; diff BENCH_rows.json artifacts.
+
+Two subcommands on one small CLI:
+
+* ``python tools/trace_report.py TRACE`` — load a Chrome-trace-event
+  ``trace.json`` (or raw event ``.jsonl``) written by
+  :class:`hbbft_tpu.obs.tracer.Tracer`, validate it against the
+  trace-event schema (required keys, monotonic ``ts``, matched B/E
+  pairs), and print the per-kind time table: span category, span count,
+  total seconds, share — the device rows reproduce the
+  ``device_seconds_*`` counter split from the trace alone.
+* ``python tools/trace_report.py --diff OLD NEW`` — compare two
+  ``BENCH_rows.json`` files metric by metric and flag regressions where
+  the new value dropped more than ``--tol`` (default 10%) below the old
+  (all bench metrics are higher-is-better rates).  Exit code 1 when any
+  regression is flagged, so the check can gate CI.
+
+The validation helpers are imported by the test suite
+(tests/test_obs_tracer.py, tests/test_trace_smoke.py) — keep them
+dependency-free.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, List, Tuple
+
+#: keys every span event must carry (Chrome trace-event format)
+REQUIRED_KEYS = ("name", "ph", "ts", "pid", "tid")
+
+
+def load_events(path: str) -> List[Dict[str, Any]]:
+    """Events from a Chrome trace (``{"traceEvents": [...]}``) or JSONL."""
+    with open(path) as f:
+        if path.endswith(".jsonl"):
+            return [json.loads(line) for line in f if line.strip()]
+        doc = json.load(f)
+    if isinstance(doc, dict) and "traceEvents" in doc:
+        return doc["traceEvents"]
+    raise ValueError(f"{path}: not a Chrome trace or event JSONL")
+
+
+def validate_chrome_trace(events: List[Dict[str, Any]]) -> List[str]:
+    """Schema errors (empty list = valid).
+
+    Checks: every event carries the required keys; ``ts`` is monotonic
+    non-decreasing in file order; on each (pid, tid) the B/E events form
+    a properly nested stack with matching names; no span left open.
+    """
+    errors: List[str] = []
+    last_ts = None
+    stacks: Dict[Tuple[Any, Any], List[str]] = {}
+    for i, ev in enumerate(events):
+        for k in REQUIRED_KEYS:
+            if k not in ev:
+                errors.append(f"event {i}: missing key {k!r}")
+        ph = ev.get("ph")
+        ts = ev.get("ts")
+        if not isinstance(ts, (int, float)) or ts < 0:
+            errors.append(f"event {i}: bad ts {ts!r}")
+            continue
+        if last_ts is not None and ts < last_ts:
+            errors.append(f"event {i}: ts {ts} < previous {last_ts}")
+        last_ts = ts
+        key = (ev.get("pid"), ev.get("tid"))
+        if ph == "B":
+            stacks.setdefault(key, []).append(ev.get("name", ""))
+        elif ph == "E":
+            stack = stacks.get(key)
+            if not stack:
+                errors.append(f"event {i}: E with no open B on tid {key[1]}")
+            elif stack[-1] != ev.get("name", ""):
+                errors.append(
+                    f"event {i}: E {ev.get('name')!r} closes "
+                    f"open B {stack[-1]!r} on tid {key[1]}"
+                )
+                stack.pop()
+            else:
+                stack.pop()
+        elif ph not in ("M",):
+            errors.append(f"event {i}: unknown ph {ph!r}")
+    for key, stack in stacks.items():
+        for name in stack:
+            errors.append(f"unclosed span {name!r} on tid {key[1]}")
+    return errors
+
+
+def span_durations(events: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """Closed spans as {name, cat, tid, dur_us, device, args}."""
+    out: List[Dict[str, Any]] = []
+    stacks: Dict[Tuple[Any, Any], List[Dict[str, Any]]] = {}
+    for ev in events:
+        ph = ev.get("ph")
+        key = (ev.get("pid"), ev.get("tid"))
+        if ph == "B":
+            stacks.setdefault(key, []).append(ev)
+        elif ph == "E":
+            stack = stacks.get(key)
+            if stack:
+                b = stack.pop()
+                args = b.get("args", {})
+                out.append(
+                    {
+                        "name": b.get("name", ""),
+                        "cat": b.get("cat", ""),
+                        "tid": key[1],
+                        "dur_us": ev["ts"] - b["ts"],
+                        "device": bool(args.get("device")),
+                        "args": args,
+                    }
+                )
+    return out
+
+
+def device_span_seconds(events: List[Dict[str, Any]]) -> float:
+    """Total wall seconds of device=True dispatch spans — should agree
+    with counters.device_seconds (±5%; both bill the same dispatch+fetch
+    interval)."""
+    return sum(
+        s["dur_us"] for s in span_durations(events) if s["device"]
+    ) / 1e6
+
+
+def kind_table(events: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """Per-category totals, device dispatches split from protocol spans.
+
+    ``share`` is each category's total against the trace's WALL duration
+    (first B to last E), not the sum of all span durations — spans nest
+    (epoch ⊃ subset ⊃ rbc) and the lockstep engine replicates per-proposer
+    instance spans across tracks, so a duration-sum denominator would
+    understate enclosing spans.  Shares can therefore sum past 100%: an
+    ``epoch`` row near 100% wall is the expected reading."""
+    agg: Dict[Tuple[str, bool], Dict[str, float]] = {}
+    for s in span_durations(events):
+        key = (s["cat"] or "span", s["device"])
+        a = agg.setdefault(key, {"count": 0, "us": 0.0})
+        a["count"] += 1
+        a["us"] += s["dur_us"]
+    ts = [e["ts"] for e in events if e.get("ph") in ("B", "E")]
+    wall_us = (max(ts) - min(ts)) if ts else 0.0
+    rows = [
+        {
+            "cat": cat,
+            "device": device,
+            "count": int(a["count"]),
+            "seconds": a["us"] / 1e6,
+            "share": a["us"] / wall_us if wall_us else 0.0,
+        }
+        for (cat, device), a in agg.items()
+    ]
+    rows.sort(key=lambda r: -r["seconds"])
+    return rows
+
+
+def report(path: str) -> int:
+    events = load_events(path)
+    errors = validate_chrome_trace(events)
+    if errors:
+        print(f"{path}: INVALID ({len(errors)} schema errors)")
+        for e in errors[:20]:
+            print("  " + e)
+        return 1
+    spans = [e for e in events if e.get("ph") in ("B", "E")]
+    print(f"{path}: valid; {len(spans) // 2} spans")
+    dev = device_span_seconds(events)
+    print(f"device dispatch time (device=True spans): {dev:.4f} s")
+    print(f"{'cat':>12} {'where':>7} {'count':>8} {'seconds':>10} {'wall%':>7}")
+    for r in kind_table(events):
+        where = "device" if r["device"] else "host"
+        print(
+            f"{r['cat']:>12} {where:>7} {r['count']:>8} "
+            f"{r['seconds']:>10.4f} {r['share']:>6.1%}"
+        )
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# BENCH_rows.json diff
+# ---------------------------------------------------------------------------
+
+
+def _rows_by_metric(path: str) -> Dict[str, Dict[str, Any]]:
+    with open(path) as f:
+        doc = json.load(f)
+    rows = doc["rows"] if isinstance(doc, dict) else doc
+    return {
+        r["metric"]: r
+        for r in rows
+        if isinstance(r.get("value"), (int, float))
+    }
+
+
+def diff_rows(
+    old_path: str, new_path: str, tol: float = 0.10
+) -> List[Dict[str, Any]]:
+    """Per-metric comparison; ``regression`` flags a >tol drop (all bench
+    metrics are higher-is-better rates)."""
+    old, new = _rows_by_metric(old_path), _rows_by_metric(new_path)
+    out = []
+    for metric in sorted(set(old) | set(new)):
+        o, n = old.get(metric), new.get(metric)
+        entry: Dict[str, Any] = {"metric": metric}
+        if o is None or n is None:
+            entry["status"] = "only_in_new" if o is None else "only_in_old"
+            entry["regression"] = False
+        else:
+            entry["old"] = o["value"]
+            entry["new"] = n["value"]
+            entry["ratio"] = n["value"] / o["value"] if o["value"] else None
+            entry["regression"] = bool(
+                o["value"] and n["value"] < o["value"] * (1.0 - tol)
+            )
+        out.append(entry)
+    return out
+
+
+def report_diff(old_path: str, new_path: str, tol: float) -> int:
+    entries = diff_rows(old_path, new_path, tol)
+    regressed = [e for e in entries if e["regression"]]
+    for e in entries:
+        if "ratio" in e:
+            flag = "  REGRESSION" if e["regression"] else ""
+            ratio = f"{e['ratio']:.3f}x" if e["ratio"] is not None else "n/a"
+            print(f"{e['metric']:>40} {e['old']:>12} -> {e['new']:>12} {ratio}{flag}")
+        else:
+            print(f"{e['metric']:>40} {e['status']}")
+    print(
+        f"{len(regressed)} regression(s) beyond {tol:.0%} "
+        f"across {len(entries)} metrics"
+    )
+    return 1 if regressed else 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("paths", nargs="+", help="TRACE, or OLD NEW with --diff")
+    p.add_argument(
+        "--diff", action="store_true",
+        help="treat the two paths as BENCH_rows.json files to compare",
+    )
+    p.add_argument(
+        "--tol", type=float, default=0.10,
+        help="relative drop flagged as a regression (default 0.10)",
+    )
+    args = p.parse_args(argv)
+    if args.diff:
+        if len(args.paths) != 2:
+            p.error("--diff needs exactly two BENCH_rows.json paths")
+        return report_diff(args.paths[0], args.paths[1], args.tol)
+    if len(args.paths) != 1:
+        p.error("exactly one trace path (or --diff OLD NEW)")
+    return report(args.paths[0])
+
+
+if __name__ == "__main__":
+    sys.exit(main())
