@@ -1,0 +1,284 @@
+"""AST lint engine: findings, suppressions, baseline, rule registry.
+
+Pure stdlib (``ast`` + ``re`` + ``json``) — the linter never imports the
+code under analysis, so a full run costs parse time only (<10s on CPU; no
+JAX import) and cannot be affected by import-time side effects.
+
+Suppression syntax (same line as the finding, or a comment-only line
+immediately above it)::
+
+    self._counts[k] += 1  # lint: allow[determinism] counting is commutative
+
+A suppression must carry a reason; a bare ``# lint: allow[rule]`` is not
+honoured and is itself reported (rule id ``lint-allow``).
+
+The baseline file grandfathers known findings: each entry is the multiset
+key ``(rule, path, message)`` with a count, so moving a grandfathered
+finding within its file does not trip CI but adding a new instance does.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import json
+import re
+import tokenize
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
+
+SUPPRESS_RE = re.compile(r"#\s*lint:\s*allow\[([A-Za-z0-9_,\- ]+)\]\s*(.*)")
+
+#: rule id used for meta-findings about malformed suppressions
+ALLOW_RULE_ID = "lint-allow"
+
+
+@dataclass(frozen=True, slots=True)
+class Finding:
+    """One lint finding, anchored to a source location."""
+
+    rule: str
+    path: str  # repo-relative posix path
+    line: int
+    col: int
+    message: str
+
+    def sort_key(self) -> Tuple:
+        return (self.path, self.line, self.col, self.rule, self.message)
+
+    def baseline_key(self) -> Tuple[str, str, str]:
+        return (self.rule, self.path, self.message)
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: [{self.rule}] {self.message}"
+
+
+class ModuleSource:
+    """One parsed module: source text, AST, and suppression table."""
+
+    def __init__(self, path: str, text: str) -> None:
+        self.path = path
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree = ast.parse(text, filename=path)
+        #: line -> set of rule ids allowed on that line ("*" = all)
+        self.allowed: Dict[int, set] = {}
+        #: (line, rule-list) of suppressions missing a reason
+        self.bare_allows: List[Tuple[int, str]] = []
+        self._scan_suppressions()
+
+    def _scan_suppressions(self) -> None:
+        # Real COMMENT tokens only (tokenize): allow-syntax quoted inside a
+        # docstring or string literal must not create phantom suppressions.
+        try:
+            tokens = list(tokenize.generate_tokens(io.StringIO(self.text).readline))
+        except (tokenize.TokenError, IndentationError, SyntaxError):
+            return  # surfaced separately as a syntax finding
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = SUPPRESS_RE.search(tok.string)
+            if m is None:
+                continue
+            line = tok.start[0]
+            rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+            reason = m.group(2).strip()
+            if not reason:
+                self.bare_allows.append((line, ",".join(sorted(rules))))
+                continue  # not honoured without a reason
+            target = line
+            code = self.lines[line - 1][: tok.start[1]].strip()
+            if not code:
+                # Comment-only line: applies to the next source line.
+                target = line + 1
+            self.allowed.setdefault(target, set()).update(rules)
+
+    def is_suppressed(self, rule: str, line: int) -> bool:
+        rules = self.allowed.get(line)
+        return rules is not None and (rule in rules or "*" in rules)
+
+
+class LintProject:
+    """All modules under analysis, keyed by repo-relative posix path."""
+
+    def __init__(self, repo_root: Path, modules: Dict[str, ModuleSource]) -> None:
+        self.repo_root = repo_root
+        self.modules = modules
+
+    @staticmethod
+    def load(repo_root: Path, paths: Iterable[Path]) -> "LintProject":
+        modules: Dict[str, ModuleSource] = {}
+        for p in sorted(paths):
+            rel = p.resolve().relative_to(repo_root.resolve()).as_posix()
+            text = p.read_text(encoding="utf-8")
+            try:
+                modules[rel] = ModuleSource(rel, text)
+            except SyntaxError as e:
+                # Surfaced as a finding rather than crashing the run.
+                broken = ModuleSource.__new__(ModuleSource)
+                broken.path = rel
+                broken.text = text
+                broken.lines = text.splitlines()
+                broken.tree = ast.Module(body=[], type_ignores=[])
+                broken.allowed = {}
+                broken.bare_allows = []
+                broken.syntax_error = e  # type: ignore[attr-defined]
+                modules[rel] = broken
+        return LintProject(repo_root, modules)
+
+    def module(self, path: str) -> Optional[ModuleSource]:
+        return self.modules.get(path)
+
+
+class Rule:
+    """Base class: subclasses set ``rule_id``/``scope`` and override one of
+    ``check_module`` (per-file) or ``check_project`` (cross-file)."""
+
+    rule_id: str = ""
+    #: path prefixes this rule applies to (posix, repo-relative)
+    scope: Tuple[str, ...] = ()
+
+    def applies_to(self, path: str) -> bool:
+        return not self.scope or any(path.startswith(pfx) for pfx in self.scope)
+
+    def check_module(self, mod: ModuleSource) -> List[Finding]:
+        return []
+
+    def check_project(self, project: LintProject) -> List[Finding]:
+        out: List[Finding] = []
+        for path in sorted(project.modules):
+            if self.applies_to(path):
+                out.extend(self.check_module(project.modules[path]))
+        return out
+
+
+_REGISTRY: Dict[str, Callable[[], Rule]] = {}
+
+
+def register(rule_cls):
+    """Class decorator adding a rule to the global registry."""
+    if not rule_cls.rule_id:
+        raise ValueError(f"{rule_cls.__name__} has no rule_id")
+    if rule_cls.rule_id in _REGISTRY:
+        raise ValueError(f"duplicate rule id {rule_cls.rule_id!r}")
+    _REGISTRY[rule_cls.rule_id] = rule_cls
+    return rule_cls
+
+
+def all_rules() -> List[Rule]:
+    """Instantiate every registered rule (importing the rule modules)."""
+    # Imports are deferred so `engine` has no circular dependency on rules.
+    from hbbft_tpu.analysis import (  # noqa: F401
+        rules_byzantine,
+        rules_determinism,
+        rules_exhaustiveness,
+        rules_tracer,
+    )
+
+    return [_REGISTRY[rid]() for rid in sorted(_REGISTRY)]
+
+
+# ---------------------------------------------------------------------------
+# Baseline
+# ---------------------------------------------------------------------------
+
+
+class Baseline:
+    """Multiset of grandfathered findings keyed by (rule, path, message)."""
+
+    def __init__(self, counts: Optional[Dict[Tuple[str, str, str], int]] = None) -> None:
+        self.counts: Dict[Tuple[str, str, str], int] = dict(counts or {})
+
+    @staticmethod
+    def from_findings(findings: Iterable[Finding]) -> "Baseline":
+        b = Baseline()
+        for f in findings:
+            k = f.baseline_key()
+            b.counts[k] = b.counts.get(k, 0) + 1
+        return b
+
+    @staticmethod
+    def load(path: Path) -> "Baseline":
+        if not path.exists():
+            return Baseline()
+        data = json.loads(path.read_text(encoding="utf-8"))
+        counts: Dict[Tuple[str, str, str], int] = {}
+        for entry in data.get("findings", []):
+            key = (entry["rule"], entry["path"], entry["message"])
+            counts[key] = int(entry.get("count", 1))
+        return Baseline(counts)
+
+    def save(self, path: Path) -> None:
+        entries = [
+            {"rule": rule, "path": p, "message": msg, "count": n}
+            for (rule, p, msg), n in sorted(self.counts.items())
+        ]
+        path.write_text(
+            json.dumps({"version": 1, "findings": entries}, indent=2) + "\n",
+            encoding="utf-8",
+        )
+
+    def new_findings(self, findings: Sequence[Finding]) -> List[Finding]:
+        """Findings beyond the grandfathered counts (deterministic: for each
+        key the *first* ``count`` occurrences in sorted order are absorbed)."""
+        remaining = dict(self.counts)
+        out: List[Finding] = []
+        for f in sorted(findings, key=Finding.sort_key):
+            k = f.baseline_key()
+            if remaining.get(k, 0) > 0:
+                remaining[k] -= 1
+            else:
+                out.append(f)
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Runner
+# ---------------------------------------------------------------------------
+
+
+def iter_python_files(root: Path) -> List[Path]:
+    return sorted(p for p in root.rglob("*.py") if "__pycache__" not in p.parts)
+
+
+def run_lint(
+    repo_root: Path,
+    paths: Optional[Iterable[Path]] = None,
+    rules: Optional[Sequence[Rule]] = None,
+) -> List[Finding]:
+    """Run ``rules`` over ``paths`` (default: all of hbbft_tpu/).
+
+    Returns unsuppressed findings in deterministic sorted order.
+    Suppressions without a reason surface as ``lint-allow`` findings.
+    """
+    if paths is None:
+        paths = iter_python_files(repo_root / "hbbft_tpu")
+    project = LintProject.load(repo_root, paths)
+    if rules is None:
+        rules = all_rules()
+
+    findings: List[Finding] = []
+    for path, mod in project.modules.items():
+        err = getattr(mod, "syntax_error", None)
+        if err is not None:
+            findings.append(
+                Finding("syntax", path, err.lineno or 1, 0, f"syntax error: {err.msg}")
+            )
+        for line, rules_txt in mod.bare_allows:
+            findings.append(
+                Finding(
+                    ALLOW_RULE_ID,
+                    path,
+                    line,
+                    0,
+                    f"suppression allow[{rules_txt}] has no reason; not honoured",
+                )
+            )
+    for rule in rules:
+        for f in rule.check_project(project):
+            mod = project.module(f.path)
+            if mod is not None and mod.is_suppressed(f.rule, f.line):
+                continue
+            findings.append(f)
+    return sorted(findings, key=Finding.sort_key)

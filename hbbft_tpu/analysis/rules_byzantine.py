@@ -1,0 +1,199 @@
+"""Byzantine-input discipline: remote input faults, never raises.
+
+Scope: ``hbbft_tpu/protocols/``.  A remote peer controls every byte that
+reaches a ``handle_*(self, sender_id, ...)`` entry point.  Two contracts:
+
+* **No raising on remote input.** A malformed message is *evidence*
+  (``Step.from_fault`` / ``PartOutcome(fault=...)``), not an exception —
+  an uncaught exception from one crafted message is a remote crash of the
+  replica (the cheapest possible Byzantine attack).  Any ``raise`` inside
+  a remote-input handler is flagged; programming-error asserts belong in
+  internal helpers, not on the network boundary.
+
+* **Membership before state writes.** A handler must check the sender
+  against the validator set (``node_index``/``is_node_validator``/
+  ``in``-membership) before mutating ``self`` state, otherwise any
+  non-member can grow per-sender maps or future-message queues without
+  bound (memory DoS) or influence quorum counts.
+
+Both checks are per-method AST heuristics over the handler body only:
+delegation into ``_handle_*`` helpers is trusted (the helpers' own checks
+are exercised by the adversarial tests).  Remote handlers are methods
+named ``handle_*`` whose parameter list includes ``sender_id`` or
+``sender`` — matching ``ConsensusProtocol.handle_message`` and the
+SyncKeyGen ``handle_part``/``handle_ack`` family; ``handle_input`` (local
+input, trusted embedder) is deliberately out of scope.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from hbbft_tpu.analysis.engine import Finding, ModuleSource, Rule, register
+
+_SENDER_PARAMS = ("sender_id", "sender")
+_MEMBERSHIP_CALLS = ("node_index", "is_node_validator", "is_validator", "senders")
+_MUTATING_METHODS = (
+    "append",
+    "add",
+    "insert",
+    "extend",
+    "setdefault",
+    "update",
+    "pop",
+    "popitem",
+    "clear",
+    "remove",
+    "discard",
+    "push",
+)
+
+
+def _sender_param(fn: ast.FunctionDef) -> Optional[str]:
+    names = [a.arg for a in fn.args.args]
+    for p in _SENDER_PARAMS:
+        if p in names:
+            return p
+    return None
+
+
+def _root_name(node: ast.AST) -> Optional[str]:
+    while isinstance(node, (ast.Attribute, ast.Subscript, ast.Call)):
+        node = node.func if isinstance(node, ast.Call) else node.value
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _is_state_write(node: ast.AST) -> bool:
+    """Does this statement/expression mutate ``self`` state?"""
+    if isinstance(node, (ast.Assign, ast.AugAssign)):
+        targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+        for t in targets:
+            if isinstance(t, (ast.Attribute, ast.Subscript)) and _root_name(t) == "self":
+                return True
+        return False
+    if isinstance(node, ast.Expr) and isinstance(node.value, ast.Call):
+        call = node.value
+        if (
+            isinstance(call.func, ast.Attribute)
+            and call.func.attr in _MUTATING_METHODS
+            and _root_name(call.func.value) == "self"
+        ):
+            return True
+    return False
+
+
+def _mentions_membership_check(node: ast.AST, sender: str) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call) and isinstance(sub.func, ast.Attribute):
+            # The check must be about the *sender*: `self.netinfo.
+            # is_validator()` (our own membership) does not qualify.
+            arg_names = {a.id for a in sub.args if isinstance(a, ast.Name)}
+            if sub.func.attr in _MEMBERSHIP_CALLS and sender in arg_names:
+                return True
+            # index-map lookup idiom: `self.index.get(sender_id)`
+            if sub.func.attr == "get" and sender in arg_names:
+                return True
+        if isinstance(sub, ast.Compare):
+            if any(isinstance(op, (ast.In, ast.NotIn)) for op in sub.ops):
+                if isinstance(sub.left, ast.Name) and sub.left.id == sender:
+                    return True
+    return False
+
+
+@register
+class ByzantineInputRule(Rule):
+    rule_id = "byzantine-input"
+    scope = ("hbbft_tpu/protocols/",)
+
+    def check_module(self, mod: ModuleSource) -> List[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            for fn in node.body:
+                if not isinstance(fn, ast.FunctionDef):
+                    continue
+                if not fn.name.startswith("handle_") or fn.name == "handle_input":
+                    continue
+                sender = _sender_param(fn)
+                if sender is None:
+                    continue
+                findings.extend(self._check_handler(mod, node.name, fn, sender))
+        return findings
+
+    def _check_handler(
+        self, mod: ModuleSource, cls: str, fn: ast.FunctionDef, sender: str
+    ) -> List[Finding]:
+        findings: List[Finding] = []
+        for sub in self._escaping_raises(fn):
+            findings.append(
+                Finding(
+                    self.rule_id,
+                    mod.path,
+                    sub.lineno,
+                    sub.col_offset,
+                    f"{cls}.{fn.name} raises on remote input; "
+                    "return a FaultLog entry instead",
+                )
+            )
+
+        # Statement-ordered scan: first self-state write must be preceded
+        # by a sender-membership check somewhere earlier in the body.
+        checked = False
+        for stmt in self._linear_statements(fn):
+            if not checked and _mentions_membership_check(stmt, sender):
+                checked = True
+            if _is_state_write(stmt) and not checked:
+                findings.append(
+                    Finding(
+                        self.rule_id,
+                        mod.path,
+                        stmt.lineno,
+                        stmt.col_offset,
+                        f"{cls}.{fn.name} writes state before checking "
+                        f"{sender} membership",
+                    )
+                )
+                break
+        return findings
+
+    @classmethod
+    def _escaping_raises(cls, node: ast.AST, in_try: bool = False):
+        """Raise nodes not enclosed by a ``try`` with except handlers —
+        the ``raise``-then-convert-to-fault idiom inside a local try/except
+        (sync_key_gen validation) is legal; an escaping raise is not."""
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.Raise):
+                if not in_try:
+                    yield child
+                continue
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                continue  # nested scope: only called, not part of this body
+            if isinstance(child, ast.Try) and child.handlers:
+                for grand in child.body + child.orelse:
+                    yield from cls._escaping_raises(grand, in_try=True)
+                # except/finally bodies propagate outward
+                for handler in child.handlers:
+                    for grand in handler.body:
+                        yield from cls._escaping_raises(grand, in_try=in_try)
+                for grand in child.finalbody:
+                    yield from cls._escaping_raises(grand, in_try=in_try)
+            else:
+                yield from cls._escaping_raises(child, in_try=in_try)
+
+    @staticmethod
+    def _linear_statements(fn: ast.FunctionDef):
+        """Statements in source order, descending into control flow."""
+        stack = list(reversed(fn.body))
+        while stack:
+            stmt = stack.pop()
+            yield stmt
+            for field in ("body", "orelse", "finalbody"):
+                for child in reversed(getattr(stmt, field, [])):
+                    stack.append(child)
+            for handler in getattr(stmt, "handlers", []):
+                for child in reversed(handler.body):
+                    stack.append(child)

@@ -1,0 +1,237 @@
+"""Handler-exhaustiveness rule: wire variants ↔ ``handle_message`` dispatch.
+
+The wire codec (``hbbft_tpu/utils/wire.py``) declares the full message
+hierarchy in ``WIRE_VARIANTS``.  Every variant a peer can legally put on
+the wire must be dispatched somewhere in the owning protocol's handler
+class, and every kind string the handler dispatches on must exist on the
+wire — otherwise one of two drift bugs has happened:
+
+* **unhandled variant** — the codec decodes it, the protocol silently
+  mis-files it (usually into an ``unknown_kind`` fault against an honest
+  peer, which is itself a safety hazard: correct nodes must never accuse
+  each other).
+* **orphaned kind** — the handler dispatches on a kind the codec can
+  never deliver; dead code that hides a missing wire registration.
+
+Convention this rule relies on (documented here, checked by the tests):
+handler classes compare the *message parameter*, named ``message`` or
+``msg``, via ``message.kind == "..."`` or ``message.kind in (...)``.
+Comparisons on other receivers (e.g. ``out.kind`` for protocol outputs)
+are deliberately ignored.
+
+The rule also drift-checks ``WIRE_VARIANTS`` against the codec itself:
+every registered class must appear in an ``isinstance`` test in
+``_to_tree``, and every registered tag/kind must occur as a string
+literal in the module.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from hbbft_tpu.analysis.engine import Finding, LintProject, Rule, register
+
+WIRE_PATH = "hbbft_tpu/utils/wire.py"
+
+#: message class -> (module path, handler class) owning its dispatch
+HANDLERS: Dict[str, Tuple[str, str]] = {
+    "SbvMessage": ("hbbft_tpu/protocols/sbv_broadcast.py", "SbvBroadcast"),
+    "BroadcastMessage": ("hbbft_tpu/protocols/broadcast.py", "Broadcast"),
+    "BaMessage": ("hbbft_tpu/protocols/binary_agreement.py", "BinaryAgreement"),
+    "SubsetMessage": ("hbbft_tpu/protocols/subset.py", "Subset"),
+    "HbMessage": ("hbbft_tpu/protocols/honey_badger.py", "HoneyBadger"),
+    "SqMessage": ("hbbft_tpu/protocols/sender_queue.py", "SenderQueue"),
+}
+
+_MSG_PARAM_NAMES = ("message", "msg")
+
+
+def _load_wire_variants(tree: ast.AST) -> Optional[Dict[str, Tuple[str, Tuple[str, ...]]]]:
+    """Extract the WIRE_VARIANTS literal from wire.py's AST (no import)."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == "WIRE_VARIANTS":
+                    try:
+                        value = ast.literal_eval(node.value)
+                    except (ValueError, SyntaxError):
+                        return None
+                    return {
+                        cls: (tag, tuple(kinds))
+                        for cls, (tag, kinds) in value.items()
+                    }
+    return None
+
+
+def _kind_literals_for_class(tree: ast.AST, class_name: str) -> Tuple[Set[str], int]:
+    """Kind strings compared against ``message.kind``/``msg.kind`` inside
+    ``class_name``, plus the class's definition line."""
+    kinds: Set[str] = set()
+    class_line = 1
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name == class_name:
+            class_line = node.lineno
+            for sub in ast.walk(node):
+                if not isinstance(sub, ast.Compare):
+                    continue
+                left = sub.left
+                if not (
+                    isinstance(left, ast.Attribute)
+                    and left.attr == "kind"
+                    and isinstance(left.value, ast.Name)
+                    and left.value.id in _MSG_PARAM_NAMES
+                ):
+                    continue
+                for op, comparator in zip(sub.ops, sub.comparators):
+                    if isinstance(op, (ast.Eq, ast.NotEq)) and isinstance(
+                        comparator, ast.Constant
+                    ):
+                        if isinstance(comparator.value, str):
+                            kinds.add(comparator.value)
+                    elif isinstance(op, (ast.In, ast.NotIn)) and isinstance(
+                        comparator, (ast.Tuple, ast.List, ast.Set)
+                    ):
+                        for elt in comparator.elts:
+                            if isinstance(elt, ast.Constant) and isinstance(
+                                elt.value, str
+                            ):
+                                kinds.add(elt.value)
+    return kinds, class_line
+
+
+def _isinstance_classes(tree: ast.AST, func_name: str) -> Set[str]:
+    """Class names tested via isinstance(...) inside function ``func_name``."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef) and node.name == func_name:
+            for sub in ast.walk(node):
+                if (
+                    isinstance(sub, ast.Call)
+                    and isinstance(sub.func, ast.Name)
+                    and sub.func.id == "isinstance"
+                    and len(sub.args) == 2
+                ):
+                    cls = sub.args[1]
+                    if isinstance(cls, ast.Name):
+                        out.add(cls.id)
+    return out
+
+
+@register
+class HandlerExhaustivenessRule(Rule):
+    rule_id = "handler-exhaustiveness"
+    scope = ("hbbft_tpu/",)
+
+    def check_project(self, project: LintProject) -> List[Finding]:
+        findings: List[Finding] = []
+        wire = project.module(WIRE_PATH)
+        if wire is None:
+            return findings  # partial run (--diff) without wire.py: skip
+        variants = _load_wire_variants(wire.tree)
+        if variants is None:
+            return [
+                Finding(
+                    self.rule_id,
+                    WIRE_PATH,
+                    1,
+                    0,
+                    "WIRE_VARIANTS registry missing or not a literal",
+                )
+            ]
+
+        # -- registry ↔ codec drift ---------------------------------------
+        codec_classes = _isinstance_classes(wire.tree, "_to_tree")
+        # String literals outside the registry itself — the registry's own
+        # entries must not satisfy their own presence check.
+        registry_nodes = set()
+        for node in ast.walk(wire.tree):
+            if isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == "WIRE_VARIANTS"
+                for t in node.targets
+            ):
+                registry_nodes = {id(sub) for sub in ast.walk(node)}
+        wire_strings = {
+            n.value
+            for n in ast.walk(wire.tree)
+            if isinstance(n, ast.Constant)
+            and isinstance(n.value, str)
+            and id(n) not in registry_nodes
+        }
+        for cls, (tag, kinds) in sorted(variants.items()):
+            if cls not in codec_classes:
+                findings.append(
+                    Finding(
+                        self.rule_id,
+                        WIRE_PATH,
+                        1,
+                        0,
+                        f"registered class {cls} is never encoded by _to_tree",
+                    )
+                )
+            for lit in (tag, *kinds):
+                if lit not in wire_strings:
+                    findings.append(
+                        Finding(
+                            self.rule_id,
+                            WIRE_PATH,
+                            1,
+                            0,
+                            f"registered variant {cls}:{lit!r} does not appear in the wire codec",
+                        )
+                    )
+        for cls in sorted(codec_classes - set(variants)):
+            if cls in ("WireError",):
+                continue
+            findings.append(
+                Finding(
+                    self.rule_id,
+                    WIRE_PATH,
+                    1,
+                    0,
+                    f"class {cls} encoded by _to_tree but missing from WIRE_VARIANTS",
+                )
+            )
+
+        # -- registry ↔ handler dispatch -----------------------------------
+        for cls, (path, handler_cls) in sorted(HANDLERS.items()):
+            reg = variants.get(cls)
+            if reg is None:
+                findings.append(
+                    Finding(
+                        self.rule_id,
+                        WIRE_PATH,
+                        1,
+                        0,
+                        f"handler mapping for {cls} has no WIRE_VARIANTS entry",
+                    )
+                )
+                continue
+            _tag, kinds = reg
+            if not kinds:
+                continue  # single-variant message: nothing to dispatch on
+            mod = project.module(path)
+            if mod is None:
+                continue  # partial run without the handler module
+            handled, class_line = _kind_literals_for_class(mod.tree, handler_cls)
+            for k in sorted(set(kinds) - handled):
+                findings.append(
+                    Finding(
+                        self.rule_id,
+                        path,
+                        class_line,
+                        0,
+                        f"{handler_cls} does not dispatch wire variant {cls}:{k!r}",
+                    )
+                )
+            for k in sorted(handled - set(kinds)):
+                findings.append(
+                    Finding(
+                        self.rule_id,
+                        path,
+                        class_line,
+                        0,
+                        f"{handler_cls} dispatches {cls}:{k!r} which no wire variant delivers",
+                    )
+                )
+        return findings

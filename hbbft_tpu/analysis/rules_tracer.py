@@ -1,0 +1,222 @@
+"""JAX tracer-safety rule: no host syncs inside jitted code.
+
+Scope: ``hbbft_tpu/engine/`` and ``hbbft_tpu/ops/`` — the device layer.
+
+Inside a jit-compiled function every array argument is a tracer; the
+following force a host round-trip (``ConcretizationTypeError`` at best, a
+silent per-call device sync at worst when tracing succeeds via weak
+types) and are flagged:
+
+* ``float(x)`` / ``int(x)`` / ``bool(x)`` on non-constant arguments —
+  concretizes a tracer.
+* ``.item()`` / ``.tolist()`` — explicit device→host transfer.
+* ``np.asarray`` / ``np.array`` / ``onp.asarray`` on traced values —
+  silently materializes on host (``jnp.asarray`` is the device-side
+  spelling and is fine).
+* ``jax.device_get`` — explicit transfer.
+
+A function is considered jitted when it is decorated with ``@jax.jit`` /
+``@partial(jax.jit, ...)`` / ``@functools.partial(jax.jit, ...)``, or its
+name is passed to ``jax.jit(...)`` anywhere in the same module (the
+``ops/backend.py`` ``_jitted_*`` factory idiom).  Inner ``def``\\ s of a
+jitted function are jitted too.
+
+Additionally, host-side crank loops must not sync per iteration:
+``jax.device_get``/``.item()``/``.tolist()`` inside a ``for``/``while``
+body is flagged even outside jit (one transfer per loop iteration is the
+classic dispatch-throughput killer — batch the transfer after the loop).
+
+Static-argument hashability: calls to a function jitted with
+``static_argnums`` must not pass ``list``/``dict``/``set`` literals in a
+static position, and ``static_argnames`` must not receive them by
+keyword — jit caches on static args by hash.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from hbbft_tpu.analysis.engine import Finding, ModuleSource, Rule, register
+
+_CONCRETIZERS = ("float", "int", "bool")
+_SYNC_METHODS = ("item", "tolist")
+_NUMPY_NAMES = ("np", "numpy", "onp")
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for a Name/Attribute chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+def _is_jax_jit(node: ast.AST) -> bool:
+    return _dotted(node) in ("jax.jit", "jit")
+
+
+def _jit_call_info(call: ast.Call) -> Optional[Tuple[Optional[str], Set[int], Set[str]]]:
+    """If ``call`` is ``jax.jit(target?, static_argnums=..., ...)`` return
+    (target function name or None, static positions, static names)."""
+    if not _is_jax_jit(call.func):
+        return None
+    target: Optional[str] = None
+    if call.args and isinstance(call.args[0], ast.Name):
+        target = call.args[0].id
+    nums: Set[int] = set()
+    names: Set[str] = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            try:
+                v = ast.literal_eval(kw.value)
+            except (ValueError, SyntaxError):
+                continue
+            nums.update([v] if isinstance(v, int) else list(v))
+        elif kw.arg == "static_argnames":
+            try:
+                v = ast.literal_eval(kw.value)
+            except (ValueError, SyntaxError):
+                continue
+            names.update([v] if isinstance(v, str) else list(v))
+    return target, nums, names
+
+
+def _decorator_jit_info(fn: ast.FunctionDef) -> Optional[Tuple[Set[int], Set[str]]]:
+    """Static-arg info when ``fn`` is decorated as jitted, else None."""
+    for dec in fn.decorator_list:
+        if _is_jax_jit(dec):
+            return set(), set()
+        if isinstance(dec, ast.Call):
+            if _is_jax_jit(dec.func):
+                info = _jit_call_info(dec)
+                if info:
+                    return info[1], info[2]
+                return set(), set()
+            if _dotted(dec.func) in ("partial", "functools.partial"):
+                if dec.args and _is_jax_jit(dec.args[0]):
+                    info = _jit_call_info(
+                        ast.Call(func=dec.args[0], args=[], keywords=dec.keywords)
+                    )
+                    if info:
+                        return info[1], info[2]
+                    return set(), set()
+    return None
+
+
+@register
+class TracerSafetyRule(Rule):
+    rule_id = "tracer-safety"
+    scope = ("hbbft_tpu/engine/", "hbbft_tpu/ops/")
+
+    def check_module(self, mod: ModuleSource) -> List[Finding]:
+        findings: List[Finding] = []
+
+        # -- pass 1: which function *bodies* are traced, and which callable
+        # names carry static-arg semantics.  For `alias = jax.jit(g, ...)`
+        # the body is g's but the static contract lives on calls to
+        # `alias`; calling raw `g` is plain Python and is exempt.
+        jit_bodies: Set[str] = set()
+        static_info: Dict[str, Tuple[Set[int], Set[str]]] = {}
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Assign):
+                if isinstance(node.value, ast.Call):
+                    info = _jit_call_info(node.value)
+                    if info:
+                        if info[0] is not None:
+                            jit_bodies.add(info[0])
+                        for t in node.targets:
+                            if isinstance(t, ast.Name):
+                                static_info[t.id] = (info[1], info[2])
+            elif isinstance(node, ast.Call):
+                info = _jit_call_info(node)
+                if info and info[0] is not None:
+                    jit_bodies.add(info[0])
+            elif isinstance(node, ast.FunctionDef):
+                dec_info = _decorator_jit_info(node)
+                if dec_info is not None:
+                    jit_bodies.add(node.name)
+                    static_info[node.name] = dec_info
+
+        def emit(node: ast.AST, message: str) -> None:
+            findings.append(
+                Finding(self.rule_id, mod.path, node.lineno, node.col_offset, message)
+            )
+
+        # -- pass 2: host syncs inside jitted function bodies -------------
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.FunctionDef) and node.name in jit_bodies:
+                self._scan_jit_body(node, emit)
+
+        # -- pass 3: per-iteration syncs in host loops --------------------
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.For, ast.While)):
+                for sub in ast.walk(node):
+                    if not isinstance(sub, ast.Call):
+                        continue
+                    if _dotted(sub.func) == "jax.device_get":
+                        emit(sub, "jax.device_get inside a loop; batch the transfer")
+                    elif (
+                        isinstance(sub.func, ast.Attribute)
+                        and sub.func.attr in _SYNC_METHODS
+                        and not sub.args
+                    ):
+                        emit(
+                            sub,
+                            f".{sub.func.attr}() inside a loop; batch the transfer",
+                        )
+
+        # -- pass 4: unhashable literals in static positions --------------
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = node.func.id if isinstance(node.func, ast.Name) else None
+            if name not in static_info:
+                continue
+            nums, names = static_info[name]
+            for i, arg in enumerate(node.args):
+                if i in nums and isinstance(arg, (ast.List, ast.Dict, ast.Set)):
+                    emit(
+                        arg,
+                        f"unhashable literal passed to static_argnums position {i} of {name}",
+                    )
+            for kw in node.keywords:
+                if kw.arg in names and isinstance(kw.value, (ast.List, ast.Dict, ast.Set)):
+                    emit(
+                        kw.value,
+                        f"unhashable literal passed to static arg {kw.arg!r} of {name}",
+                    )
+        return findings
+
+    def _scan_jit_body(self, fn: ast.FunctionDef, emit) -> None:
+        for sub in ast.walk(fn):
+            if not isinstance(sub, ast.Call):
+                continue
+            func = sub.func
+            if isinstance(func, ast.Name) and func.id in _CONCRETIZERS:
+                if sub.args and not isinstance(sub.args[0], ast.Constant):
+                    emit(
+                        sub,
+                        f"{func.id}() on a traced value inside jitted "
+                        f"{fn.name}() concretizes the tracer",
+                    )
+            elif isinstance(func, ast.Attribute):
+                dotted = _dotted(func)
+                if dotted == "jax.device_get":
+                    emit(sub, f"jax.device_get inside jitted {fn.name}()")
+                elif func.attr in _SYNC_METHODS and not sub.args:
+                    emit(sub, f".{func.attr}() inside jitted {fn.name}() is a host sync")
+                elif dotted is not None and any(
+                    dotted == f"{m}.{a}"
+                    for m in _NUMPY_NAMES
+                    for a in ("asarray", "array")
+                ):
+                    emit(
+                        sub,
+                        f"{dotted} inside jitted {fn.name}() materializes on host; "
+                        "use jnp",
+                    )

@@ -121,6 +121,11 @@ class BinaryAgreement(ConsensusProtocol):
             return Step.from_fault(sender_id, "binary_agreement:malformed_message")
         if self.netinfo.node_index(sender_id) is None:
             return Step.from_fault(sender_id, "binary_agreement:non_validator_sender")
+        if not isinstance(message.round, int):
+            # Unvalidated round would TypeError in the comparisons below —
+            # a remote crash vector (wire decode enforces int, but locally
+            # embedded adversaries can inject arbitrary objects).
+            return Step.from_fault(sender_id, "binary_agreement:malformed_round")
         if message.kind == "term":
             return self._handle_term(sender_id, message)
         if self.decision is not None:
@@ -177,7 +182,15 @@ class BinaryAgreement(ConsensusProtocol):
         if not isinstance(vals, BoolSet) or not vals:
             return Step.from_fault(sender_id, "binary_agreement:malformed_conf")
         if sender_id in self.received_conf:
-            return Step()  # duplicate/racing-with-Term-replay: ignore
+            # A Term replay pre-fills received_conf, so a conf racing its
+            # own sender's Term is legal; absent a Term, two different Conf
+            # values in one round are provable equivocation.
+            if (
+                self.received_conf[sender_id] != vals
+                and sender_id not in self.received_term.senders()
+            ):
+                return Step.from_fault(sender_id, "binary_agreement:conflicting_conf")
+            return Step()
         self.received_conf[sender_id] = vals
         return self._poll()
 

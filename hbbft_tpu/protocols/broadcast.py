@@ -148,7 +148,9 @@ class Broadcast(ConsensusProtocol):
             return Step.from_fault(sender_id, "broadcast:echo_from_non_validator")
         if sender_id in self.echos:
             if self.echos[sender_id] == proof:
-                return Step()
+                # Re-sent Echo: provable misbehaviour under exactly-once
+                # delivery (reference `Fault::MultipleEchos`), not a drop.
+                return Step.from_fault(sender_id, "broadcast:multiple_echos")
             return Step.from_fault(sender_id, "broadcast:conflicting_echo")
         # An Echo must carry the *sender's* shard (AVID dispersal).
         if not self._validate_proof(proof, sender_idx):
@@ -181,7 +183,8 @@ class Broadcast(ConsensusProtocol):
             return Step.from_fault(sender_id, "broadcast:ready_from_non_validator")
         if sender_id in self.readys:
             if self.readys[sender_id] == root:
-                return Step()
+                # Re-sent Ready (reference `Fault::MultipleReadys`).
+                return Step.from_fault(sender_id, "broadcast:multiple_readys")
             return Step.from_fault(sender_id, "broadcast:conflicting_ready")
         self.readys[sender_id] = root
         step = Step()
@@ -204,8 +207,11 @@ class Broadcast(ConsensusProtocol):
             return Step()
         f = self.netinfo.num_faulty()
         # Find a root with ≥ 2f+1 Readys and ≥ N-2f stored Echo shards.
+        # (sorted: at most one root can reach a Ready quorum — conflicting
+        # Readys are rejected per sender — but candidate order must still be
+        # replica-independent for the fault-evidence path below.)
         candidates: Set[bytes] = {r for r in self.readys.values()}
-        for root in candidates:
+        for root in sorted(candidates):
             if self._count_readys(root) <= 2 * f:
                 continue
             proofs = {

@@ -279,6 +279,20 @@ class DynamicHoneyBadger(ConsensusProtocol):
         if message.era > self.era + 1:
             return Step.from_fault(sender_id, "dynamic_honey_badger:era_too_far_ahead")
         if message.era > self.era:
+            # Fault next-era traffic only on *provable* non-membership:
+            # with a DKG in progress the era+1 member set is known
+            # (current validators ∪ key_gen.pub_keys covers a joiner that
+            # finished the era first).  Before the DKG-start batch is
+            # processed, era+1 membership is undetermined — buffer as
+            # before rather than drop an honest early sender.
+            if (
+                self.key_gen is not None
+                and not self.netinfo.is_node_validator(sender_id)
+                and sender_id not in self.key_gen.pub_keys
+            ):
+                return Step.from_fault(
+                    sender_id, "dynamic_honey_badger:future_era_from_non_member"
+                )
             self._future_era.append((sender_id, message))
             return Step()
         return self._wrap_hb(

@@ -234,6 +234,12 @@ class HoneyBadger(ConsensusProtocol):
         if e > self.epoch + self.max_future_epochs:
             return Step.from_fault(sender_id, "honey_badger:epoch_too_far_ahead")
         if e > self.epoch:
+            if not self.netinfo.is_node_validator(sender_id):
+                # Only validators may grow the future-epoch buffer: anyone
+                # else could inflate it without bound (memory DoS).
+                return Step.from_fault(
+                    sender_id, "honey_badger:future_epoch_from_non_validator"
+                )
             self._future.setdefault(e, []).append((sender_id, message))
             return Step()
         return self._handle_current(sender_id, message)

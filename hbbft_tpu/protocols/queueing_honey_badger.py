@@ -142,6 +142,7 @@ class QueueingHoneyBadger(ConsensusProtocol):
         )
 
     def _on_batch(self, batch: DhbBatch) -> Step:
+        # lint: allow[determinism] queue removals commute; order irrelevant
         for contributions in batch.contributions.values():
             if isinstance(contributions, list):
                 self.queue.remove_multiple(contributions)

@@ -112,6 +112,7 @@ class SbvBroadcast:
         # Count distinct Aux senders whose value is in bin_values.
         vals = BoolSet.none()
         count = 0
+        # lint: allow[determinism] BoolSet union and counting are commutative
         for sender, b in self.received_aux.items():
             if self.bin_values.contains(b):
                 vals = vals.inserted(b)

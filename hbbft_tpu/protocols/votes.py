@@ -60,6 +60,7 @@ class VoteCounter:
 
     def tally(self) -> Dict[Tuple, int]:
         counts: Dict[Tuple, int] = {}
+        # lint: allow[determinism] vote counting is commutative; winner() sorts
         for v in self._latest.values():
             key = v.change.to_canonical()
             counts[key] = counts.get(key, 0) + 1

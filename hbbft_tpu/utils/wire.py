@@ -40,6 +40,27 @@ class WireError(ValueError):
     """Malformed or unknown wire bytes."""
 
 
+#: The wire-variant registry: every message class the codec speaks, mapped to
+#: its (wire tag, kind-variant tuple).  This is the single enumeration that
+#: (a) the handler-exhaustiveness lint rule cross-references against each
+#: protocol's ``handle_message`` dispatch, (b) tests/test_wire_properties.py
+#: walks to prove canonical encode/decode round-trips, and (c) the codec
+#: below is drift-checked against by the lint rule (every registered kind
+#: must appear as a literal in this module).  Adding a message variant
+#: without updating all three breaks the tier-1 suite — by design.
+WIRE_VARIANTS = {
+    "SbvMessage": ("sbv", ("bval", "aux")),
+    "ThresholdSignMessage": ("tsig", ()),
+    "ThresholdDecryptMessage": ("tdec", ()),
+    "BroadcastMessage": ("bc", ("value", "echo", "ready")),
+    "BaMessage": ("ba", ("sbv", "conf", "coin", "term")),
+    "SubsetMessage": ("ss", ("broadcast", "agreement")),
+    "HbMessage": ("hb", ("subset", "dec_share")),
+    "DhbMessage": ("dhb", ()),
+    "SqMessage": ("sq", ("epoch_started", "algo")),
+}
+
+
 def _to_tree(msg: Any) -> Any:
     if isinstance(msg, SbvMessage):
         if msg.kind not in ("bval", "aux"):

@@ -1,0 +1,554 @@
+"""Protocol-invariant linter: tier-1 gate + per-rule seeded violations.
+
+The gate test runs the full rule suite over ``hbbft_tpu/`` exactly as
+``tools/lint.py`` does and fails on any finding beyond the checked-in
+baseline — so a PR that introduces nondeterministic iteration, an
+unhandled wire variant, a raising handler, or a host sync in jitted code
+breaks tier-1.
+
+Each rule family also gets unit tests proving it (a) catches a seeded
+violation and (b) honours ``# lint: allow[rule] reason`` suppressions.
+"""
+
+import textwrap
+from pathlib import Path
+
+from hbbft_tpu.analysis.engine import (
+    Baseline,
+    Finding,
+    LintProject,
+    ModuleSource,
+    all_rules,
+    iter_python_files,
+    run_lint,
+)
+from hbbft_tpu.analysis.rules_byzantine import ByzantineInputRule
+from hbbft_tpu.analysis.rules_determinism import DeterminismRule
+from hbbft_tpu.analysis.rules_exhaustiveness import WIRE_PATH, HandlerExhaustivenessRule
+from hbbft_tpu.analysis.rules_tracer import TracerSafetyRule
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+BASELINE_PATH = REPO_ROOT / "tools" / "lint_baseline.json"
+
+
+def lint_sources(rule, sources):
+    """Run one rule over {path: source} with suppression filtering."""
+    modules = {p: ModuleSource(p, textwrap.dedent(src)) for p, src in sources.items()}
+    project = LintProject(REPO_ROOT, modules)
+    out = []
+    for f in rule.check_project(project):
+        mod = project.module(f.path)
+        if mod is not None and mod.is_suppressed(f.rule, f.line):
+            continue
+        out.append(f)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Tier-1 gate
+# ---------------------------------------------------------------------------
+
+
+def test_package_lint_clean():
+    """Full run over hbbft_tpu/: zero findings beyond the baseline."""
+    findings = run_lint(REPO_ROOT, iter_python_files(REPO_ROOT / "hbbft_tpu"))
+    new = Baseline.load(BASELINE_PATH).new_findings(findings)
+    assert not new, "new lint findings:\n" + "\n".join(f.render() for f in new)
+
+
+def test_lint_output_deterministic():
+    paths = iter_python_files(REPO_ROOT / "hbbft_tpu")
+    a = run_lint(REPO_ROOT, paths)
+    b = run_lint(REPO_ROOT, list(reversed(paths)))
+    assert a == b
+    assert a == sorted(a, key=Finding.sort_key)
+
+
+# ---------------------------------------------------------------------------
+# Rule family 1: determinism
+# ---------------------------------------------------------------------------
+
+DET_PATH = "hbbft_tpu/protocols/_seeded.py"
+
+
+def test_determinism_catches_violations():
+    findings = lint_sources(
+        DeterminismRule(),
+        {
+            DET_PATH: """\
+            import time
+            import os
+
+            def emit(self):
+                now = time.time()
+                salt = os.urandom(8)
+                for peer in self.echos.values():
+                    self.send(peer)
+                order = sorted(self.ids, key=lambda x: id(x))
+                return now, salt, order
+            """
+        },
+    )
+    msgs = [f.message for f in findings]
+    assert any("nondeterministic module 'time'" in m for m in msgs)
+    assert any("time.time()" in m for m in msgs)
+    assert any("os.urandom" in m for m in msgs)
+    assert any(".values()" in m for m in msgs)
+    assert any("id()" in m for m in msgs)
+
+
+def test_determinism_set_iteration_and_safe_sinks():
+    findings = lint_sources(
+        DeterminismRule(),
+        {
+            DET_PATH: """\
+            class P:
+                def __init__(self):
+                    self.peers = set()
+
+                def bad(self):
+                    return [p for p in self.peers]
+
+                def good(self):
+                    total = sum(1 for v in self.counts.values() if v)
+                    roots = {r for r in self.readys.values()}
+                    ordered = sorted(self.peers)
+                    return total, roots, ordered
+            """
+        },
+    )
+    assert len(findings) == 1
+    assert "set-typed 'self.peers'" in findings[0].message
+    assert findings[0].line == 6
+
+
+def test_determinism_enumerate_leaks_order_through_sinks():
+    """enumerate() bakes arrival order into values, so it is flagged even
+    when the comprehension builds an unordered container."""
+    findings = lint_sources(
+        DeterminismRule(),
+        {
+            DET_PATH: """\
+            class P:
+                def __init__(self):
+                    self.peers = set()
+
+                def bad(self):
+                    return {k: i for i, k in enumerate(self.peers)}
+
+                def also_bad(self):
+                    for i, v in enumerate(self.m.values()):
+                        self.rank[v] = i
+
+                def fine(self):
+                    return {k: i for i, k in enumerate(sorted(self.peers))}
+            """
+        },
+    )
+    assert len(findings) == 2
+    assert all("enumerate over nondeterministic order" in f.message for f in findings)
+
+
+def test_determinism_respects_suppression():
+    src = """\
+    class P:
+        def count(self):
+            n = 0
+            for v in self.latest.values():  # lint: allow[determinism] counting commutes
+                n += 1
+            return n
+    """
+    assert lint_sources(DeterminismRule(), {DET_PATH: src}) == []
+    # The same code without a reason is NOT suppressed.
+    bare = src.replace(" counting commutes", "")
+    assert len(lint_sources(DeterminismRule(), {DET_PATH: bare})) == 1
+
+
+def test_determinism_out_of_scope_paths_ignored():
+    src = "import time\n"
+    assert lint_sources(DeterminismRule(), {"hbbft_tpu/ops/_x.py": src}) == []
+    assert len(lint_sources(DeterminismRule(), {"hbbft_tpu/core/_x.py": src})) == 1
+
+
+# ---------------------------------------------------------------------------
+# Rule family 2: handler exhaustiveness
+# ---------------------------------------------------------------------------
+
+_FAKE_WIRE = """\
+WIRE_VARIANTS = {
+    "FooMessage": ("foo", ("ping", "pong")),
+}
+
+
+def _to_tree(msg):
+    if isinstance(msg, FooMessage):
+        if msg.kind == "ping":
+            return ("foo", "ping")
+        return ("foo", "pong")
+    raise ValueError
+"""
+
+_FAKE_HANDLER_TMPL = """\
+class Foo:
+    def handle_message(self, sender_id, message):
+        if message.kind == "ping":
+            return self._ping(sender_id)
+        {extra}
+        return self.fault(sender_id, "unknown")
+"""
+
+
+def _exhaustiveness(handler_src, wire_src=_FAKE_WIRE):
+    rule = HandlerExhaustivenessRule()
+    rule_handlers = {"FooMessage": ("hbbft_tpu/protocols/_foo.py", "Foo")}
+    import hbbft_tpu.analysis.rules_exhaustiveness as rx
+
+    saved = rx.HANDLERS
+    rx.HANDLERS = rule_handlers
+    try:
+        return lint_sources(
+            rule,
+            {WIRE_PATH: wire_src, "hbbft_tpu/protocols/_foo.py": handler_src},
+        )
+    finally:
+        rx.HANDLERS = saved
+
+
+def test_exhaustiveness_flags_unhandled_variant():
+    findings = _exhaustiveness(_FAKE_HANDLER_TMPL.format(extra="pass"))
+    assert any("does not dispatch wire variant FooMessage:'pong'" in f.message for f in findings)
+
+
+def test_exhaustiveness_flags_orphaned_kind():
+    src = _FAKE_HANDLER_TMPL.format(
+        extra='if message.kind in ("pong", "zap"):\n            return None'
+    )
+    findings = _exhaustiveness(src)
+    assert any("dispatches FooMessage:'zap'" in f.message for f in findings)
+    assert not any("does not dispatch" in f.message for f in findings)
+
+
+def test_exhaustiveness_clean_handler_passes():
+    src = _FAKE_HANDLER_TMPL.format(
+        extra='if message.kind == "pong":\n            return None'
+    )
+    assert _exhaustiveness(src) == []
+
+
+def test_exhaustiveness_detects_registry_codec_drift():
+    wire = _FAKE_WIRE.replace('("ping", "pong")', '("ping", "pong", "ghost")')
+    src = _FAKE_HANDLER_TMPL.format(
+        extra='if message.kind in ("pong", "ghost"):\n            return None'
+    )
+    findings = _exhaustiveness(src, wire_src=wire)
+    assert any("'ghost'" in f.message and "wire codec" in f.message for f in findings)
+
+
+def test_exhaustiveness_real_registry_matches_handlers():
+    """The real wire registry and protocol handlers agree (redundant with
+    the gate test, but pins the rule to its real cross-file inputs)."""
+    paths = [REPO_ROOT / WIRE_PATH] + [
+        REPO_ROOT / p for p, _ in __import__(
+            "hbbft_tpu.analysis.rules_exhaustiveness", fromlist=["HANDLERS"]
+        ).HANDLERS.values()
+    ]
+    findings = run_lint(REPO_ROOT, paths, rules=[HandlerExhaustivenessRule()])
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# Rule family 3: byzantine-input discipline
+# ---------------------------------------------------------------------------
+
+BYZ_PATH = "hbbft_tpu/protocols/_byz.py"
+
+
+def test_byzantine_flags_raise_in_handler():
+    findings = lint_sources(
+        ByzantineInputRule(),
+        {
+            BYZ_PATH: """\
+            class P:
+                def handle_message(self, sender_id, message):
+                    if not isinstance(message, tuple):
+                        raise ValueError("bad message")
+                    return None
+            """
+        },
+    )
+    assert len(findings) == 1
+    assert "raises on remote input" in findings[0].message
+
+
+def test_byzantine_allows_locally_converted_raise():
+    findings = lint_sources(
+        ByzantineInputRule(),
+        {
+            BYZ_PATH: """\
+            class P:
+                def handle_part(self, sender_id, part):
+                    idx = self.index.get(sender_id)
+                    try:
+                        if not part:
+                            raise ValueError
+                    except ValueError:
+                        return self.fault(sender_id, "malformed")
+                    return None
+            """
+        },
+    )
+    assert findings == []
+
+
+def test_byzantine_flags_write_before_membership_check():
+    src = """\
+    class P:
+        def handle_message(self, sender_id, message):
+            self.future.setdefault(message.epoch, []).append((sender_id, message))
+            if self.netinfo.node_index(sender_id) is None:
+                return self.fault(sender_id, "non_validator")
+            return None
+    """
+    findings = lint_sources(ByzantineInputRule(), {BYZ_PATH: src})
+    assert len(findings) == 1
+    assert "writes state before checking sender_id membership" in findings[0].message
+
+
+def test_byzantine_membership_check_first_passes():
+    src = """\
+    class P:
+        def handle_message(self, sender_id, message):
+            if self.netinfo.node_index(sender_id) is None:
+                return self.fault(sender_id, "non_validator")
+            self.future.setdefault(message.epoch, []).append((sender_id, message))
+            return None
+    """
+    assert lint_sources(ByzantineInputRule(), {BYZ_PATH: src}) == []
+
+
+def test_byzantine_respects_suppression():
+    src = """\
+    class P:
+        def handle_message(self, sender_id, message):
+            # lint: allow[byzantine-input] epoch tracker accepts observers by design
+            self.peer_epochs[sender_id] = message
+            return None
+    """
+    assert lint_sources(ByzantineInputRule(), {BYZ_PATH: src}) == []
+
+
+def test_byzantine_self_membership_check_does_not_count():
+    """`self.netinfo.is_validator()` checks OUR membership, not the
+    sender's — it must not satisfy the membership-before-write contract."""
+    src = """\
+    class P:
+        def handle_message(self, sender_id, message):
+            if not self.netinfo.is_validator():
+                return None
+            self.queue.setdefault(sender_id, []).append(message)
+            return None
+    """
+    findings = lint_sources(ByzantineInputRule(), {BYZ_PATH: src})
+    assert len(findings) == 1
+    assert "writes state before checking" in findings[0].message
+
+
+def test_byzantine_handle_input_out_of_scope():
+    src = """\
+    class P:
+        def handle_input(self, input, rng=None):
+            raise ValueError("unknown input kind")
+    """
+    assert lint_sources(ByzantineInputRule(), {BYZ_PATH: src}) == []
+
+
+# ---------------------------------------------------------------------------
+# Rule family 4: JAX tracer safety
+# ---------------------------------------------------------------------------
+
+TRACER_PATH = "hbbft_tpu/ops/_seeded.py"
+
+
+def test_tracer_flags_host_syncs_in_jitted_fn():
+    findings = lint_sources(
+        TracerSafetyRule(),
+        {
+            TRACER_PATH: """\
+            import jax
+            import numpy as np
+
+            @jax.jit
+            def kernel(x):
+                n = int(x.shape[0])
+                y = float(x[0])
+                z = x.sum().item()
+                h = np.asarray(x)
+                return y + z, h, n
+            """
+        },
+    )
+    msgs = [f.message for f in findings]
+    assert any("float() on a traced value" in m for m in msgs)
+    assert any(".item() inside jitted" in m for m in msgs)
+    assert any("np.asarray inside jitted" in m for m in msgs)
+
+
+def test_tracer_factory_idiom_and_loops():
+    findings = lint_sources(
+        TracerSafetyRule(),
+        {
+            TRACER_PATH: """\
+            import jax
+
+            def f(x):
+                return bool(x)
+
+            jitted = jax.jit(f)
+
+            def crank(items):
+                out = []
+                for x in items:
+                    out.append(jax.device_get(x))
+                return out
+            """
+        },
+    )
+    msgs = [f.message for f in findings]
+    assert any("bool() on a traced value" in m for m in msgs)
+    assert any("jax.device_get inside a loop" in m for m in msgs)
+
+
+def test_tracer_unhashable_static_arg():
+    findings = lint_sources(
+        TracerSafetyRule(),
+        {
+            TRACER_PATH: """\
+            import jax
+
+            def g(x, shape):
+                return x
+
+            fast_g = jax.jit(g, static_argnums=(1,))
+
+            def use(x):
+                a = fast_g(x, [4, 4])   # unhashable at the jit boundary
+                b = g(x, [4, 4])        # plain Python call: legal
+                return a, b
+            """
+        },
+    )
+    unhashable = [f for f in findings if "unhashable literal" in f.message]
+    assert len(unhashable) == 1
+    assert "of fast_g" in unhashable[0].message
+    assert unhashable[0].line == 9
+
+
+def test_tracer_clean_and_suppressed():
+    clean = """\
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def kernel(x):
+        return jnp.asarray(x) + 1
+
+    def host(x):
+        return float(x)
+    """
+    assert lint_sources(TracerSafetyRule(), {TRACER_PATH: clean}) == []
+    suppressed = """\
+    import jax
+
+    @jax.jit
+    def kernel(x, n):
+        k = int(n)  # lint: allow[tracer-safety] n is a static python int
+        return x[:k]
+    """
+    assert lint_sources(TracerSafetyRule(), {TRACER_PATH: suppressed}) == []
+
+
+def test_tracer_out_of_scope_protocols_ignored():
+    src = """\
+    import jax
+
+    @jax.jit
+    def kernel(x):
+        return float(x)
+    """
+    assert lint_sources(TracerSafetyRule(), {"hbbft_tpu/protocols/_x.py": src}) == []
+
+
+# ---------------------------------------------------------------------------
+# Engine mechanics: suppressions, baseline, registry
+# ---------------------------------------------------------------------------
+
+
+def test_bare_suppression_is_reported_and_not_honoured():
+    src = textwrap.dedent(
+        """\
+        import time  # lint: allow[determinism]
+        """
+    )
+    mod = ModuleSource("hbbft_tpu/core/_x.py", src)
+    assert mod.bare_allows == [(1, "determinism")]
+    assert not mod.is_suppressed("determinism", 1)
+
+
+def test_allow_syntax_in_string_literals_is_ignored():
+    """Docstrings/strings *quoting* the allow syntax are not comments:
+    no phantom suppressions, no spurious lint-allow findings."""
+    src = textwrap.dedent(
+        '''\
+        """Docs: write `# lint: allow[determinism]` to suppress a line."""
+        X = "# lint: allow[determinism] not a real comment"
+        import time
+        '''
+    )
+    mod = ModuleSource("hbbft_tpu/core/_x.py", src)
+    assert mod.bare_allows == []
+    assert mod.allowed == {}
+    findings = lint_sources(DeterminismRule(), {"hbbft_tpu/core/_x.py": src})
+    assert len(findings) == 1  # the import is still flagged
+
+
+def test_suppression_on_preceding_comment_line():
+    src = textwrap.dedent(
+        """\
+        # lint: allow[determinism] ordering provably irrelevant here
+        import time
+        """
+    )
+    mod = ModuleSource("hbbft_tpu/core/_x.py", src)
+    assert mod.is_suppressed("determinism", 2)
+    assert not mod.is_suppressed("determinism", 1)
+
+
+def test_baseline_grandfathers_by_count():
+    f1 = Finding("r", "p.py", 3, 0, "msg")
+    f2 = Finding("r", "p.py", 9, 0, "msg")
+    f3 = Finding("r", "p.py", 12, 0, "other")
+    baseline = Baseline.from_findings([f1])
+    new = baseline.new_findings([f1, f2, f3])
+    # One "msg" absorbed (the earliest), the second plus "other" are new.
+    assert new == [f2, f3]
+
+
+def test_baseline_roundtrip(tmp_path):
+    baseline = Baseline.from_findings(
+        [Finding("r", "p.py", 3, 0, "msg"), Finding("r", "p.py", 9, 0, "msg")]
+    )
+    path = tmp_path / "baseline.json"
+    baseline.save(path)
+    loaded = Baseline.load(path)
+    assert loaded.counts == baseline.counts
+    assert Baseline.load(tmp_path / "missing.json").counts == {}
+
+
+def test_all_rules_registered():
+    ids = {r.rule_id for r in all_rules()}
+    assert ids == {
+        "determinism",
+        "handler-exhaustiveness",
+        "byzantine-input",
+        "tracer-safety",
+    }

@@ -1,0 +1,113 @@
+"""Canonical round-trip property over the full wire-variant registry.
+
+Walks ``WIRE_VARIANTS`` — the same enumeration the handler-exhaustiveness
+lint rule cross-references — and proves every (class, kind) variant
+encodes to canonical bytes and decodes back to an equal message whose
+re-encoding is byte-identical (the fixed-point property signatures
+depend on).  The constructor table below is keyed by the registry, so
+adding a wire variant without extending this test fails loudly here and
+in tests/test_lint.py simultaneously.
+"""
+
+import random
+
+import pytest
+
+from hbbft_tpu.crypto.backend import MockBackend
+from hbbft_tpu.crypto.keys import SecretKeySet
+from hbbft_tpu.crypto.merkle import MerkleTree
+from hbbft_tpu.protocols.binary_agreement import BaMessage
+from hbbft_tpu.protocols.bool_set import BoolSet
+from hbbft_tpu.protocols.broadcast import BroadcastMessage
+from hbbft_tpu.protocols.dynamic_honey_badger import DhbMessage
+from hbbft_tpu.protocols.honey_badger import HbMessage
+from hbbft_tpu.protocols.sbv_broadcast import SbvMessage
+from hbbft_tpu.protocols.sender_queue import SqMessage
+from hbbft_tpu.protocols.subset import SubsetMessage
+from hbbft_tpu.protocols.threshold_decrypt import ThresholdDecryptMessage
+from hbbft_tpu.protocols.threshold_sign import ThresholdSignMessage
+from hbbft_tpu.utils.wire import WIRE_VARIANTS, decode_message, encode_message
+
+
+@pytest.fixture(scope="module")
+def group():
+    return MockBackend().group
+
+
+@pytest.fixture(scope="module")
+def crypto(group):
+    rng = random.Random(11)
+    sks = SecretKeySet.random(group, 1, rng)
+    sig = sks.secret_key_share(0).sign_share(b"doc")
+    ct = sks.public_keys().public_key().encrypt(b"wire-prop-plaintext!", rng)
+    dec = sks.secret_key_share(1).decrypt_share_unchecked(ct)
+    tree = MerkleTree([bytes([i]) * 8 for i in range(4)])
+    return {"sig": sig, "dec": dec, "tree": tree}
+
+
+def _examples(crypto):
+    """Representative message(s) for every (class, kind) in the registry."""
+    sig, dec, tree = crypto["sig"], crypto["dec"], crypto["tree"]
+    sbv = SbvMessage.bval(True)
+    tsig = ThresholdSignMessage(sig)
+    tdec = ThresholdDecryptMessage(dec)
+    bc_ready = BroadcastMessage.ready(tree.root_hash)
+    ba = BaMessage.term(0, False)
+    ss = SubsetMessage(2, "agreement", ba)
+    hb = HbMessage.subset(1, ss)
+    return {
+        ("SbvMessage", "bval"): [SbvMessage.bval(False), sbv],
+        ("SbvMessage", "aux"): [SbvMessage.aux(True)],
+        ("ThresholdSignMessage", None): [tsig],
+        ("ThresholdDecryptMessage", None): [tdec],
+        ("BroadcastMessage", "value"): [BroadcastMessage.value(tree.proof(1))],
+        ("BroadcastMessage", "echo"): [BroadcastMessage.echo(tree.proof(3))],
+        ("BroadcastMessage", "ready"): [bc_ready],
+        ("BaMessage", "sbv"): [BaMessage.sbv(4, sbv)],
+        ("BaMessage", "conf"): [BaMessage.conf(2, BoolSet.both())],
+        ("BaMessage", "coin"): [BaMessage.coin(5, tsig)],
+        ("BaMessage", "term"): [ba, BaMessage.term(7, True)],
+        ("SubsetMessage", "broadcast"): [SubsetMessage(0, "broadcast", bc_ready)],
+        ("SubsetMessage", "agreement"): [ss],
+        ("HbMessage", "subset"): [hb],
+        ("HbMessage", "dec_share"): [HbMessage.dec_share(3, 1, tdec)],
+        ("DhbMessage", None): [DhbMessage(0, hb)],
+        ("SqMessage", "epoch_started"): [SqMessage.epoch_started(2, 9)],
+        ("SqMessage", "algo"): [SqMessage.algo(DhbMessage(1, hb))],
+    }
+
+
+def test_examples_cover_exactly_the_registry(crypto):
+    """Registry drift breaks this test the same commit it breaks the lint
+    rule: the example table must cover every registered (class, kind)."""
+    registered = set()
+    for cls, (_tag, kinds) in WIRE_VARIANTS.items():
+        if kinds:
+            registered.update((cls, k) for k in kinds)
+        else:
+            registered.add((cls, None))
+    assert set(_examples(crypto)) == registered
+
+
+def test_every_variant_roundtrips_canonically(group, crypto):
+    for (cls, kind), msgs in sorted(
+        _examples(crypto).items(), key=lambda kv: (kv[0][0], str(kv[0][1]))
+    ):
+        for msg in msgs:
+            data = encode_message(msg)
+            assert isinstance(data, bytes) and data, (cls, kind)
+            out = decode_message(data, group)
+            assert type(out) is type(msg), (cls, kind)
+            if kind is not None:
+                assert out.kind == kind
+            # Canonical fixed point: decode∘encode is byte-stable.
+            assert encode_message(out) == data, (cls, kind)
+            # And a second decode yields an equal encoding again.
+            assert encode_message(decode_message(data, group)) == data
+
+
+def test_registry_tags_are_unique():
+    tags = [tag for tag, _ in WIRE_VARIANTS.values()]
+    assert len(tags) == len(set(tags)), "wire tags must be unambiguous"
+    for _tag, kinds in WIRE_VARIANTS.values():
+        assert len(kinds) == len(set(kinds))

@@ -1,0 +1,140 @@
+#!/usr/bin/env python
+"""Protocol-invariant linter CLI (hbbft_tpu/analysis rule engine).
+
+Usage::
+
+    python tools/lint.py                 # full run over hbbft_tpu/
+    python tools/lint.py --diff          # only files changed vs git HEAD
+    python tools/lint.py --baseline      # rewrite the grandfathered baseline
+    python tools/lint.py --ci            # ruff (if installed) + custom rules
+    python tools/lint.py path/a.py ...   # explicit file list
+
+Exit status is non-zero iff there are findings beyond the checked-in
+baseline (``tools/lint_baseline.json``).  Output is deterministically
+sorted by (path, line, col, rule, message).
+
+Suppression syntax (must carry a reason)::
+
+    x = f(s)  # lint: allow[determinism] ordering provably irrelevant: <why>
+
+The linter never imports the code under analysis — a full run is pure AST
+work and finishes in seconds on CPU (no JAX import).
+"""
+
+from __future__ import annotations
+
+import argparse
+import shutil
+import subprocess
+import sys
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO_ROOT))
+
+from hbbft_tpu.analysis.engine import (  # noqa: E402
+    Baseline,
+    Finding,
+    iter_python_files,
+    run_lint,
+)
+
+BASELINE_PATH = REPO_ROOT / "tools" / "lint_baseline.json"
+
+
+def _git_changed_files() -> list:
+    """Changed + untracked .py files under hbbft_tpu/ (repo-relative)."""
+    out = subprocess.run(
+        ["git", "status", "--porcelain", "--untracked-files=all"],
+        cwd=REPO_ROOT,
+        capture_output=True,
+        text=True,
+        check=True,
+    ).stdout
+    paths = []
+    for line in out.splitlines():
+        rel = line[3:].split(" -> ")[-1].strip().strip('"')
+        p = REPO_ROOT / rel
+        if rel.endswith(".py") and rel.startswith("hbbft_tpu/") and p.exists():
+            paths.append(p)
+    return sorted(set(paths))
+
+
+def _run_ruff() -> int:
+    """Run ruff if the binary is available; 0 when absent (gated dep)."""
+    ruff = shutil.which("ruff")
+    if ruff is None:
+        print("lint: ruff not installed; skipping ruff pass", file=sys.stderr)
+        return 0
+    proc = subprocess.run(
+        [ruff, "check", "hbbft_tpu", "tools", "tests"], cwd=REPO_ROOT
+    )
+    return proc.returncode
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("files", nargs="*", help="explicit files (default: hbbft_tpu/)")
+    ap.add_argument(
+        "--diff", action="store_true", help="lint only files changed vs git"
+    )
+    ap.add_argument(
+        "--baseline",
+        action="store_true",
+        help="rewrite tools/lint_baseline.json from the current full run",
+    )
+    ap.add_argument(
+        "--baseline-file",
+        type=Path,
+        default=BASELINE_PATH,
+        help="baseline location (default tools/lint_baseline.json)",
+    )
+    ap.add_argument(
+        "--ci",
+        action="store_true",
+        help="also run ruff (if installed); exit codes are merged",
+    )
+    args = ap.parse_args(argv)
+
+    if args.files:
+        paths = [Path(f).resolve() for f in args.files]
+    elif args.diff:
+        paths = _git_changed_files()
+        if not paths:
+            print("lint: no changed files under hbbft_tpu/")
+            return _run_ruff() if args.ci else 0
+    else:
+        paths = iter_python_files(REPO_ROOT / "hbbft_tpu")
+
+    findings = run_lint(REPO_ROOT, paths)
+
+    if args.baseline:
+        if args.diff or args.files:
+            print("lint: --baseline requires a full run", file=sys.stderr)
+            return 2
+        Baseline.from_findings(findings).save(args.baseline_file)
+        print(
+            f"lint: baseline rewritten with {len(findings)} grandfathered "
+            f"finding(s) -> {args.baseline_file}"
+        )
+        return 0
+
+    baseline = Baseline.load(args.baseline_file)
+    new = baseline.new_findings(findings)
+    grandfathered = len(findings) - len(new)
+
+    for f in new:
+        print(f.render())
+    summary = f"lint: {len(new)} new finding(s)"
+    if grandfathered:
+        summary += f", {grandfathered} grandfathered"
+    print(summary)
+
+    rc = 1 if new else 0
+    if args.ci:
+        rc = max(rc, _run_ruff())
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
