@@ -862,6 +862,11 @@ def _bench_array_engine(
     churn_ctr = {
         "device_seconds": 0.0,
         "hash_g2_seconds": 0.0,
+        # pipelined-dispatch attribution (PR 3): host staging cost and
+        # the host time hidden under device execution, both excluded
+        # from steady-state per-epoch fields like churn_time is
+        "host_assembly_seconds": 0.0,
+        "overlap_seconds": 0.0,
         # per-kind split (r4 verdict task 7): rows elide zero-valued kinds
         "device_seconds_pairing": 0.0,
         "device_seconds_rlc_sig": 0.0,
@@ -932,9 +937,23 @@ def _bench_array_engine(
         # steady-state epoch (era-change work excluded, like churn_time).
         delta = counters.diff(ctr0)
         for key in churn_ctr:
+            if key in ("host_assembly_seconds", "overlap_seconds"):
+                continue  # emitted below under their canonical names
             val = delta.get(key, 0.0) - churn_ctr[key]
             if val > 0:
                 row[f"{key}_per_epoch"] = round(val / done, 4)
+        # host/device split without a trace attached (PR 3): host-side
+        # staging per epoch, and the fraction of device dispatch wall
+        # during which the host was doing OTHER work (assembly of the
+        # next chunk) instead of blocking on the fetch.  Sync mode
+        # (HBBFT_TPU_NO_PIPELINE=1) reads overlap_fraction == 0.
+        host = delta.get("host_assembly_seconds", 0.0) - churn_ctr[
+            "host_assembly_seconds"
+        ]
+        row["host_seconds_per_epoch"] = round(max(host, 0.0) / done, 4)
+        dev = delta.get("device_seconds", 0.0) - churn_ctr["device_seconds"]
+        ovl = delta.get("overlap_seconds", 0.0) - churn_ctr["overlap_seconds"]
+        row["overlap_fraction"] = round(ovl / dev, 4) if dev > 0 else 0.0
     if coin_rounds:
         row["coin_rounds_per_ba"] = coin_rounds
         row["coin_signs_per_epoch"] = rep.coin_signs

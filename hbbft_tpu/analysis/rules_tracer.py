@@ -30,6 +30,16 @@ Static-argument hashability: calls to a function jitted with
 ``static_argnums`` must not pass ``list``/``dict``/``set`` literals in a
 static position, and ``static_argnames`` must not receive them by
 keyword — jit caches on static args by hash.
+
+A second rule in the family, ``deferred-fetch``, guards the pipelined
+dispatch seam (ops/pipeline.py): inside the dispatch layer
+(``ops/backend.py`` and ``parallel/backend.py``) every device→host
+fetch must route through the pipeline's single sync point
+(``pipeline.fetch_to_host``), so ``np.asarray``/``numpy.asarray``/
+``jax.device_get``/``.block_until_ready()`` reappearing there is
+flagged — an ad-hoc fetch added next to a dispatch silently re-serializes
+the host-assembly/device-execute overlap the pipeline exists to create.
+(`np.array` on host literals and `jnp.asarray` staging remain fine.)
 """
 
 from __future__ import annotations
@@ -220,3 +230,47 @@ class TracerSafetyRule(Rule):
                         f"{dotted} inside jitted {fn.name}() materializes on host; "
                         "use jnp",
                     )
+
+
+@register
+class DeferredFetchRule(Rule):
+    """The dispatch layer's only host sync point is the deferred-fetch
+    seam (ops/pipeline.py ``fetch_to_host``): flag any ``np.asarray``,
+    ``jax.device_get`` or ``.block_until_ready()`` in ops/backend.py or
+    parallel/backend.py — an inline fetch there re-serializes the
+    pipeline (host assembly can no longer overlap device execution) and
+    bypasses the device-seconds/overlap attribution contract."""
+
+    rule_id = "deferred-fetch"
+    scope = ("hbbft_tpu/ops/backend.py", "hbbft_tpu/parallel/backend.py")
+
+    def check_module(self, mod: ModuleSource) -> List[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = _dotted(node.func)
+            message = None
+            if dotted == "jax.device_get":
+                message = "jax.device_get in the dispatch layer"
+            elif dotted is not None and any(
+                dotted == f"{m}.asarray" for m in _NUMPY_NAMES
+            ):
+                message = f"{dotted} in the dispatch layer"
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "block_until_ready"
+            ):
+                message = ".block_until_ready() in the dispatch layer"
+            if message is not None:
+                findings.append(
+                    Finding(
+                        self.rule_id,
+                        mod.path,
+                        node.lineno,
+                        node.col_offset,
+                        message + " — fetches must route through the "
+                        "deferred-fetch seam (ops/pipeline.fetch_to_host)",
+                    )
+                )
+        return findings

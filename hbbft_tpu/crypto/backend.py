@@ -263,12 +263,55 @@ class CryptoBackend(abc.ABC):
     def flush(self) -> None:
         """Device backends override to force pending batches to resolve."""
 
+    def new_era(self, era: int) -> None:
+        """Era-turnover hook (the engine calls it after every DKG):
+        device backends drop per-era staged key material (the limb-row
+        staging cache); host backends have nothing staged."""
+
 
 class MockBackend(CryptoBackend):
-    """Fast insecure backend for protocol-logic tests (mock bilinear group)."""
+    """Fast insecure backend for protocol-logic tests (mock bilinear group).
+
+    ``pipeline_chunk`` (None = off) routes the batched verifies through
+    the SAME DispatchPipeline machinery the device backend uses
+    (ops/pipeline.py — stdlib-only, no JAX import), splitting each batch
+    into chunks whose per-chunk results are delivered via deferred
+    callbacks resolved in a deterministic OUT-OF-ORDER permutation.
+    Tier-1 thereby exercises the pipeline's core safety claim — delivery
+    callbacks write disjoint slots, so completion order cannot change
+    results — without device hardware or JAX compile time.
+    """
+
+    #: chunk size for the simulated-async verify path (None = plain loop)
+    pipeline_chunk: Optional[int] = None
 
     def __init__(self) -> None:
         super().__init__(MockGroup())
+        from hbbft_tpu.ops.pipeline import DispatchPipeline
+
+        # depth large enough to hold every chunk: the mock resolves them
+        # all at once, permuted, instead of streaming
+        self._pipe = DispatchPipeline(
+            counters=None, tracer_ref=None, depth_fn=lambda: 1 << 30
+        )
+
+    def _piped(self, items: Sequence, compute: Callable[[Sequence], List]) -> List:
+        """Chunked deferred delivery with deterministic out-of-order
+        resolution (chunks resolve last-submitted-first)."""
+        step = self.pipeline_chunk or len(items) or 1
+        out: List[Any] = [None] * len(items)
+        for lo in range(0, len(items), step):
+            chunk = items[lo : lo + step]
+
+            def deliver(res, lo=lo):
+                out[lo : lo + len(res)] = res
+
+            self._pipe.submit(
+                lambda chunk=chunk: compute(chunk), fetch=None,
+                on_result=deliver,
+            )
+        self._pipe.flush(order=list(reversed(range(len(self._pipe)))))
+        return out
 
     def verify_sig_shares(self, items) -> List[bool]:
         # Inlined mock math (e(a,b) = a·b over Z_r): the generic loop costs
@@ -280,13 +323,17 @@ class MockBackend(CryptoBackend):
         c.pairing_checks += len(items)
         r = self.group.r
         h2 = self.group.hash_to_g2
-        return self._traced(
-            "pairing",
-            len(items),
-            lambda: [
-                share.el % r == (pk.el * h2(doc)) % r for pk, doc, share in items
-            ],
-        )
+
+        def compute(chunk):
+            return [
+                share.el % r == (pk.el * h2(doc)) % r for pk, doc, share in chunk
+            ]
+
+        if self.pipeline_chunk:
+            return self._traced(
+                "pairing", len(items), lambda: self._piped(items, compute)
+            )
+        return self._traced("pairing", len(items), lambda: compute(items))
 
     def verify_dec_shares(self, items) -> List[bool]:
         # Same equation as PublicKeyShare.verify_decryption_share.
@@ -294,14 +341,18 @@ class MockBackend(CryptoBackend):
         c.dec_shares_verified += len(items)
         c.pairing_checks += len(items)
         r = self.group.r
-        return self._traced(
-            "pairing",
-            len(items),
-            lambda: [
+
+        def compute(chunk):
+            return [
                 (share.el * ct.hash_point()) % r == (pk.el * ct.w) % r
-                for pk, ct, share in items
-            ],
-        )
+                for pk, ct, share in chunk
+            ]
+
+        if self.pipeline_chunk:
+            return self._traced(
+                "pairing", len(items), lambda: self._piped(items, compute)
+            )
+        return self._traced("pairing", len(items), lambda: compute(items))
 
 
 class CpuBackend(CryptoBackend):

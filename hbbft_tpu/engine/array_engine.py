@@ -663,6 +663,9 @@ class ArrayHoneyBadgerNet:
         self.threshold = first.threshold()
         self.pk_shares = [first.public_key_share(i) for i in range(n)]
         self.era += 1
+        # era-keyed staging invalidation: device backends drop the limb
+        # rows staged for the dead era's key material (ops/staging.py)
+        self.backend.new_era(self.era)
         self.churn_reports.append(rep)
         return rep
 

@@ -333,9 +333,20 @@ class HealthReporter:
             self._last_counters = cur
             beat["counters_delta"] = delta
             if dt > 0:
+                # NOTE: under pipelined dispatch (ops/pipeline.py) the
+                # per-dispatch [dispatch, fetch] intervals overlap, so
+                # device_share may legitimately exceed 1.0 — it reads as
+                # "device dispatch wall including overlapped assembly".
                 beat["device_share"] = round(
                     delta.get("device_seconds", 0.0) / dt, 4
                 )
+                host = delta.get("host_assembly_seconds", 0.0)
+                if host:
+                    beat["host_assembly_share"] = round(host / dt, 4)
+                ovl = delta.get("overlap_seconds", 0.0)
+                dev = delta.get("device_seconds", 0.0)
+                if ovl and dev > 0:
+                    beat["overlap_fraction"] = round(ovl / dev, 4)
         beat.update(extra)
         self.beats.append(beat)
         self.sink(beat)

@@ -6,7 +6,12 @@ Tracks map to Chrome-trace ``tid``\\ s, so spans on one track must nest
 (begin/end as a stack) while spans on different tracks overlap freely —
 which is exactly the lockstep engine's shape: all N BA-instance spans run
 concurrently, each on its own ``ba/<idx>`` track, under one ``subset``
-span on the main track.
+span on the main track.  The pipelined dispatch seam (ops/pipeline.py)
+uses the same mechanism for overlapping device intervals: synchronous
+dispatches span the ``device`` track, while each in-flight slot of the
+deferred-fetch queue spans its own ``device/<slot>`` track — a slot is
+reused only after its previous span's fetch completed, so per-track
+nesting holds even though dispatch+fetch intervals overlap in wall time.
 
 Export targets:
 

@@ -280,10 +280,15 @@ def scalars_to_bits(scalars: Sequence[int], width: int = SCALAR_BITS) -> np.ndar
 # ---------------------------------------------------------------------------
 
 
-def g1_to_device(points: Sequence[Optional[Tuple[int, int]]]):
-    """Affine G1 points (golden-ref (x, y) ints or None) → batched Jacobian."""
-    xs = fq.from_ints([(p[0] if p else 0) for p in points])
-    ys = fq.from_ints([(p[1] if p else 1) for p in points])
+def g1_to_device(points: Sequence[Optional[Tuple[int, int]]], cache=None):
+    """Affine G1 points (golden-ref (x, y) ints or None) → batched Jacobian.
+
+    ``cache`` (an ops/staging.StagingCache) serves repeated coordinate
+    values from the cross-call limb-row cache instead of re-running the
+    bigint conversion per dispatch."""
+    conv = cache.rows if cache is not None else fq.from_ints
+    xs = conv([(p[0] if p else 0) for p in points])
+    ys = conv([(p[1] if p else 1) for p in points])
     inf = np.array([p is None for p in points])
     zs = np.where(
         inf[:, None], np.asarray(fq.ZERO), np.asarray(fq.ONE)
@@ -291,14 +296,21 @@ def g1_to_device(points: Sequence[Optional[Tuple[int, int]]]):
     return (jnp.asarray(xs), jnp.asarray(ys), jnp.asarray(zs), jnp.asarray(inf))
 
 
-def g2_to_device(points):
+def g2_to_device(points, cache=None):
     """Affine G2 points (((x0,x1),(y0,y1)) or None) → batched Jacobian."""
-    X = tower.fq2_stack([(p[0] if p else (0, 0)) for p in points])
-    Y = tower.fq2_stack([(p[1] if p else (1, 0)) for p in points])
-    z_rows = [
-        ((1, 0) if p is not None else (0, 0)) for p in points
-    ]
-    Z = tower.fq2_stack(z_rows)
+    conv = cache.rows if cache is not None else fq.from_ints
+    X = (
+        conv([(p[0][0] if p else 0) for p in points]),
+        conv([(p[0][1] if p else 0) for p in points]),
+    )
+    Y = (
+        conv([(p[1][0] if p else 1) for p in points]),
+        conv([(p[1][1] if p else 0) for p in points]),
+    )
+    Z = (
+        conv([(1 if p is not None else 0) for p in points]),
+        conv([0 for _ in points]),
+    )
     inf = np.array([p is None for p in points])
     return (
         tuple(jnp.asarray(c) for c in X),

@@ -58,18 +58,31 @@ _X_BITS = [int(b) for b in bin(BLS_X)[3:]]
 # ---------------------------------------------------------------------------
 
 
-def g1_affine_to_device(points: Sequence[Optional[Tuple[int, int]]]):
-    """Affine G1 ints (or None) → (x, y, inf) limb batch."""
-    xs = fq.from_ints([(p[0] if p else 0) for p in points])
-    ys = fq.from_ints([(p[1] if p else 1) for p in points])
+def g1_affine_to_device(points: Sequence[Optional[Tuple[int, int]]], cache=None):
+    """Affine G1 ints (or None) → (x, y, inf) limb batch.
+
+    ``cache`` (an ops/staging.StagingCache) replaces the per-call limb
+    conversion with a cross-call value-keyed row lookup — repeated key
+    material (public key shares, generators, H2 points) is converted
+    once per era instead of once per dispatch."""
+    conv = cache.rows if cache is not None else fq.from_ints
+    xs = conv([(p[0] if p else 0) for p in points])
+    ys = conv([(p[1] if p else 1) for p in points])
     inf = np.array([p is None for p in points])
     return (jnp.asarray(xs), jnp.asarray(ys), jnp.asarray(inf))
 
 
-def g2_affine_to_device(points):
+def g2_affine_to_device(points, cache=None):
     """Affine G2 tuples (or None) → (x fq2, y fq2, inf) batch."""
-    X = tower.fq2_stack([(p[0] if p else (0, 0)) for p in points])
-    Y = tower.fq2_stack([(p[1] if p else (1, 0)) for p in points])
+    conv = cache.rows if cache is not None else fq.from_ints
+    X = (
+        conv([(p[0][0] if p else 0) for p in points]),
+        conv([(p[0][1] if p else 0) for p in points]),
+    )
+    Y = (
+        conv([(p[1][0] if p else 1) for p in points]),
+        conv([(p[1][1] if p else 0) for p in points]),
+    )
     inf = np.array([p is None for p in points])
     return (
         tuple(jnp.asarray(c) for c in X),

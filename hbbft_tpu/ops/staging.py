@@ -1,0 +1,103 @@
+"""Device-staging cache: limb rows for repeated field values.
+
+Host assembly converts Python bigint coordinates into device limb rows
+(``fq.from_ints`` — 30-bit decomposition + residue/limb matmul) on every
+dispatch.  The per-era key material is tiny and wildly repetitive: an
+N=100 epoch re-stages the same ≤100 public key shares ~990k times across
+share verifies, every RLC group row re-stages the generator and the same
+H2(doc) points, and the engine's per-receiver workload repeats each
+share N−1 times.  ``from_ints`` already deduplicates *within* one call;
+this cache makes the deduplication *cross-call*: each distinct field
+value is limb-converted once per era and thereafter gathered by index
+(`np.stack` over cached rows), so steady-state dispatches skip the
+bigint decomposition entirely.
+
+Keying is by **value** (the field integer), which is self-invalidating —
+a stale entry cannot be wrong, only dead weight — with an LRU bound
+(``HBBFT_TPU_STAGE_CAP``, default 32768 rows ≈ 25 MB at RNS width; 0
+disables) so churned eras age out.  Era turnover additionally clears the
+cache outright via ``CryptoBackend.new_era`` (the engine calls it after
+every DKG) so dead key material is dropped promptly rather than evicted
+lane by lane.
+
+One cache serves every staging form: G1/G2, affine and Jacobian, because
+they all decompose into per-coordinate ``fq`` rows.  The cache yields
+host numpy — placement (``jnp.asarray`` or MeshBackend's sharded
+``device_put``) happens downstream, so the mesh placement hook composes
+unchanged.
+"""
+
+from __future__ import annotations
+
+import os
+from collections import OrderedDict
+from typing import Optional, Sequence
+
+import numpy as np
+
+from hbbft_tpu.ops import fq
+
+
+def _default_capacity() -> int:
+    try:
+        return int(os.environ.get("HBBFT_TPU_STAGE_CAP", "32768"))
+    except ValueError:
+        return 32768
+
+
+class StagingCache:
+    """LRU of ``value → limb row`` with batched miss conversion.
+
+    ``counters`` (a ``utils.metrics.Counters`` or None) receives
+    ``stage_cache_hits``/``stage_cache_misses`` tallies, counted per
+    *distinct* value per call (the within-call fan-out was already free
+    via ``from_ints`` dedup; hits measure conversions actually skipped).
+    """
+
+    def __init__(self, capacity: Optional[int] = None, counters=None) -> None:
+        self.capacity = _default_capacity() if capacity is None else capacity
+        self.counters = counters
+        self._rows: "OrderedDict[int, np.ndarray]" = OrderedDict()
+
+    def __len__(self) -> int:
+        return len(self._rows)
+
+    def clear(self) -> None:
+        self._rows.clear()
+
+    def rows(self, vals: Sequence[int]) -> np.ndarray:
+        """(len(vals), NLIMBS) canonical limb rows — drop-in for
+        ``fq.from_ints`` (same values, dtype and shape)."""
+        if self.capacity <= 0:
+            return fq.from_ints(vals)
+        rowmap = self._rows
+        idx = np.empty(len(vals), dtype=np.int64)
+        uniq: dict = {}
+        order: list = []
+        for j, v in enumerate(vals):
+            v = int(v)
+            p = uniq.get(v)
+            if p is None:
+                p = uniq[v] = len(order)
+                order.append(v)
+            idx[j] = p
+        if not order:
+            return np.zeros((0, fq.NLIMBS), dtype=fq.NP_DTYPE)
+        missing = [v for v in order if v not in rowmap]
+        if missing:
+            conv = fq.from_ints(missing)
+            for i, v in enumerate(missing):
+                # copy: a view would pin the whole batch array in memory
+                # for as long as any one row survives in the cache
+                rowmap[v] = np.array(conv[i])
+        c = self.counters
+        if c is not None:
+            c.stage_cache_misses += len(missing)
+            c.stage_cache_hits += len(order) - len(missing)
+        urows = []
+        for v in order:
+            rowmap.move_to_end(v)
+            urows.append(rowmap[v])
+        while len(rowmap) > self.capacity:
+            rowmap.popitem(last=False)
+        return np.stack(urows)[idx]

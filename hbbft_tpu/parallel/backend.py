@@ -15,6 +15,15 @@ the data-parallel form is the one the backend seam dispatches.
 Works identically on a real multi-chip slice and on the virtual
 8-device CPU mesh (tests/conftest.py) — the mesh is the only knob.
 
+Pipelining/staging composition (PR 3): MeshBackend inherits TpuBackend's
+deferred-fetch pipeline and limb-row staging cache unchanged.  The
+staging cache yields HOST numpy rows; ``_place`` (the sharded
+``device_put``) runs downstream of it, inside the same timed
+host-assembly block, so cached staging and mesh placement compose by
+construction — each pipelined chunk is already sharded before its
+dispatch is launched, and the bounded in-flight queue bounds per-chip
+pending buffers exactly as on one chip.
+
 Reference analogue: none — the reference is sans-I/O and single-process
 (SURVEY.md §2.3); this is the TPU-native replacement for the scaling the
 reference delegates to its embedder.

@@ -43,7 +43,14 @@ def _batch_sharding(mesh: Mesh, leaf: jnp.ndarray) -> NamedSharding:
 
 
 def shard_batch(tree: Any, mesh: Mesh) -> Any:
-    """device_put every leaf with its leading axis split over the mesh."""
+    """device_put every leaf with its leading axis split over the mesh.
+
+    Accepts host numpy leaves (including rows gathered from the
+    ops/staging limb-row cache) as well as committed device arrays —
+    this is MeshBackend's ``_place`` hook, called at host-assembly time
+    BEFORE the pipelined dispatch launches, so sharded placement
+    composes with both the staging cache and the deferred-fetch queue.
+    """
     return jax.tree_util.tree_map(
         lambda leaf: jax.device_put(
             jnp.asarray(leaf), _batch_sharding(mesh, jnp.asarray(leaf))

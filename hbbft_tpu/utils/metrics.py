@@ -47,6 +47,24 @@ class Counters:
     # >10%-risk item at 29 ms/doc).
     device_seconds: float = 0.0
     hash_g2_seconds: float = 0.0
+    # pipelined-dispatch attribution (PR 3): host_assembly_seconds is the
+    # host-side staging cost (limb packing, scalars_to_bits, point
+    # conversion) the deferred-fetch pipeline overlaps with device
+    # execution; overlap_seconds is the host time actually spent doing
+    # useful work between issuing a dispatch and requesting its fetch
+    # (the hidden-under-device window, EXCLUDING stretches blocked in
+    # other entries' fetches — counting those would overstate the win);
+    # pipelined_dispatches counts dispatches whose fetch was deferred.  NOTE under pipelining the per-dispatch [dispatch, fetch]
+    # intervals overlap in wall time, so device_seconds may legitimately
+    # exceed wall clock — it remains the sum of per-dispatch intervals
+    # and still equals the traced device-span total by construction.
+    host_assembly_seconds: float = 0.0
+    overlap_seconds: float = 0.0
+    pipelined_dispatches: int = 0
+    # device-staging cache (ops/staging.py): distinct field values served
+    # from / inserted into the limb-row cache per staging call
+    stage_cache_hits: int = 0
+    stage_cache_misses: int = 0
     # device_seconds split by dispatch kind (round-4 verdict task 7: the
     # n16 on-chip epoch was 90% unattributed).  Sums to device_seconds up
     # to the rare unkinded dispatch; zero-valued kinds are elided from

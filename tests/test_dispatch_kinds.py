@@ -17,8 +17,11 @@ import hbbft_tpu.ops.backend as backend_mod
 from hbbft_tpu.utils.metrics import Counters
 
 #: seam functions whose ``kind`` parameter defaults to "" (unkinded):
-#: every CALL must therefore pass kind= explicitly
-_SEAM_FNS = ("_dispatch_fetch", "_ladder_batch", "_grouped_rlc")
+#: every CALL must therefore pass kind= explicitly (_dispatch_async is
+#: the pipelined deferred-fetch twin of _dispatch_fetch)
+_SEAM_FNS = (
+    "_dispatch_fetch", "_dispatch_async", "_ladder_batch", "_grouped_rlc",
+)
 
 
 def _counters_kinds():
@@ -72,7 +75,9 @@ def test_seam_calls_are_actually_present():
     # the guard is vacuous if a refactor renames the seam — pin the shape
     tree = ast.parse(inspect.getsource(backend_mod))
     names = [c.func.attr for c in _seam_calls(tree)]
-    assert names.count("_dispatch_fetch") >= 4
+    # sync + deferred dispatch sites together carry every device call
+    assert names.count("_dispatch_fetch") + names.count("_dispatch_async") >= 4
+    assert names.count("_dispatch_async") >= 3  # the pipelined chunk seams
     assert "_grouped_rlc" in names and "_ladder_batch" in names
 
 

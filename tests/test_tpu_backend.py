@@ -209,10 +209,14 @@ def test_combine_dec_shares_batch_lane_capped_chunks(backend, keyset, rng):
         msgs.append(msg)
     d0 = backend.counters.device_dispatches
     backend.device_combine_threshold = 2
-    backend.device_lane_cap = 4  # k=2 -> 2 items per chunk -> 3 chunks
+    # k=2 -> cap//k = 2 items, clamped UP to the _pad_bucket floor of 4
+    # (a 2-item chunk would still pad to 4 items = 8 lanes; the floor
+    # step dispatches the same 8 lanes with zero padding waste) ->
+    # chunks of 4: [0:4], [4:6] = 2 dispatches
+    backend.device_lane_cap = 4
     got = backend.combine_dec_shares_batch(pks, items)
     assert got == msgs
-    assert backend.counters.device_dispatches == d0 + 3
+    assert backend.counters.device_dispatches == d0 + 2
 
 
 def test_sign_shares_batch_device_path(backend, keyset):

@@ -25,7 +25,7 @@ from __future__ import annotations
 import argparse
 import json
 import sys
-from typing import Any, Dict, List, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 #: keys every span event must carry (Chrome trace-event format)
 REQUIRED_KEYS = ("name", "ph", "ts", "pid", "tid")
@@ -117,10 +117,30 @@ def span_durations(events: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
 def device_span_seconds(events: List[Dict[str, Any]]) -> float:
     """Total wall seconds of device=True dispatch spans — should agree
     with counters.device_seconds (±5%; both bill the same dispatch+fetch
-    interval)."""
+    interval).  Under pipelined dispatch the spans OVERLAP in wall time
+    (each in-flight slot spans its own ``device/<slot>`` track), but the
+    per-span durations still sum to the counter, because both bill the
+    same per-dispatch dispatch→fetch interval."""
     return sum(
         s["dur_us"] for s in span_durations(events) if s["device"]
     ) / 1e6
+
+
+def check_device_seconds(
+    events: List[Dict[str, Any]], expected: float, tol: float = 0.05
+) -> Tuple[bool, float]:
+    """Acceptance check: Σ device=True span durations == ``expected``
+    (counters.device_seconds, or device_seconds_per_epoch × epochs from
+    a bench row) within ``tol`` relative.  Returns (ok, measured).
+
+    This is the invariant that keeps the pipeline honest: deferring
+    fetches must not lose or double-bill device time — overlapped spans
+    still sum to the counter, so a traced run validates the attribution
+    without hardware-side profiling."""
+    got = device_span_seconds(events)
+    if expected <= 0:
+        return (got == 0.0, got)
+    return (abs(got - expected) <= tol * expected, got)
 
 
 def kind_table(events: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
@@ -154,7 +174,9 @@ def kind_table(events: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
     return rows
 
 
-def report(path: str) -> int:
+def report(
+    path: str, device_seconds: Optional[float] = None, tol: float = 0.05
+) -> int:
     events = load_events(path)
     errors = validate_chrome_trace(events)
     if errors:
@@ -173,6 +195,15 @@ def report(path: str) -> int:
             f"{r['cat']:>12} {where:>7} {r['count']:>8} "
             f"{r['seconds']:>10.4f} {r['share']:>6.1%}"
         )
+    if device_seconds is not None:
+        ok, got = check_device_seconds(events, device_seconds, tol)
+        verdict = "OK" if ok else "MISMATCH"
+        print(
+            f"device-seconds check: spans {got:.4f} s vs counter "
+            f"{device_seconds:.4f} s (±{tol:.0%}) — {verdict}"
+        )
+        if not ok:
+            return 1
     return 0
 
 
@@ -244,6 +275,16 @@ def main(argv=None) -> int:
         "--tol", type=float, default=0.10,
         help="relative drop flagged as a regression (default 0.10)",
     )
+    p.add_argument(
+        "--device-seconds", type=float, default=None,
+        help="validate that the trace's device=True spans sum to this "
+        "counter value within --device-tol (exit 1 on mismatch) — the "
+        "pipelined-dispatch acceptance check",
+    )
+    p.add_argument(
+        "--device-tol", type=float, default=0.05,
+        help="relative tolerance for --device-seconds (default 0.05)",
+    )
     args = p.parse_args(argv)
     if args.diff:
         if len(args.paths) != 2:
@@ -251,7 +292,7 @@ def main(argv=None) -> int:
         return report_diff(args.paths[0], args.paths[1], args.tol)
     if len(args.paths) != 1:
         p.error("exactly one trace path (or --diff OLD NEW)")
-    return report(args.paths[0])
+    return report(args.paths[0], args.device_seconds, args.device_tol)
 
 
 if __name__ == "__main__":
