@@ -33,6 +33,9 @@ north-star array_epochs_per_sec_n100 row):
   grouped-RLC verify → batched Lagrange combine → parity, per flip.
 * ``rlc_dec_verify_adversarial`` — the flagship shape with 1-5% forged
   shares through the bisecting fallback (adversarial throughput).
+* ``glv_ladder_ab``              — GLV joint-table vs w2 ladder A/B on the
+  backend g1_mul_batch path: per-ladder field-mul counts (2368 vs 3810
+  target) + wall ladders/s both arms (BENCH_ONLY=glv_ladder).
 * ``fq_mul_throughput``          — raw field-multiply kernel, RNS vs limb
   (subprocess A/B; BENCH_FQ=0 skips).
 * ``rs_encode_throughput``       — GF(2⁸) Reed–Solomon parity as an MXU
@@ -78,6 +81,7 @@ _FQ_ROWS = frozenset(
         "coin_e2e",
         "rlc_dec_adversarial",
         "array_n16_tpu",
+        "glv_ladder",
     }
 )
 
@@ -552,6 +556,91 @@ def bench_g2_sign() -> dict:
         "vs_baseline": round(batch / dt / 700.0, 3),
         "baseline": "estimated",
         "batch": batch,
+    }
+
+
+def bench_glv_ladder() -> dict:
+    """GLV joint-table vs w2 ladder A/B on the REAL backend G1 mul path
+    (``glv_ladder_ab``): per-ladder field-mul counts read off the
+    ladder_field_muls counter — the measurable 2368-vs-3810 prediction
+    from PERF.md's round-5 addendum — plus wall-clock ladders/s for both
+    arms.  In-process A/B: HBBFT_TPU_NO_GLV is read per batch, and the
+    two arms' bit-matrix shapes compile distinct graphs.  Fresh random
+    scalars per timed iteration (fresh-buffer discipline — the axon
+    relay memoizes repeat dispatches on identical buffers), and each
+    g1_mul_batch call ends in a host readback, which doubles as the
+    fence.  Dispatches are kinded glv_ab so the row's device seconds
+    never pollute real DKG attribution."""
+    import random
+
+    from hbbft_tpu.crypto.field import R
+    from hbbft_tpu.ops.backend import TpuBackend
+
+    # below the combine threshold the backend takes the host-golden path
+    # and no ladder is measured at all (the counters would divide by 0)
+    batch = max(
+        _env_int("BENCH_GLV_BATCH", 256), TpuBackend.device_combine_threshold
+    )
+    iters = max(1, _env_int("BENCH_GLV_ITERS", 3))
+
+    def arm(no_glv: bool):
+        saved = os.environ.pop("HBBFT_TPU_NO_GLV", None)
+        # an ambient binary-ladder A/B knob would silently disable GLV in
+        # BOTH arms and publish a vacuous reduction of 1.0
+        saved_bin = os.environ.pop("HBBFT_TPU_LADDER_BINARY", None)
+        if no_glv:
+            os.environ["HBBFT_TPU_NO_GLV"] = "1"
+        try:
+            rng = random.Random(407)
+            be = TpuBackend()
+            g1 = be.group.g1()
+            pts = [g1] * batch
+            scal = [rng.randrange(R) for _ in range(batch)]
+            be.g1_mul_batch(scal, pts, kind="glv_ab")  # compile + warm
+            c = be.counters
+            muls0, tbl0 = c.ladder_field_muls, c.glv_table_field_muls
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                scal = [rng.randrange(R) for _ in range(batch)]
+                out = be.g1_mul_batch(scal, pts, kind="glv_ab")
+            dt = time.perf_counter() - t0
+            # spot-check the last iteration against the host golden
+            i = rng.randrange(batch)
+            assert out[i] == be.group.g1_mul(scal[i], g1), "A/B arm is wrong"
+            n = iters * batch
+            return {
+                "muls_per_ladder": (c.ladder_field_muls - muls0) / n,
+                "table_muls_per_ladder": (c.glv_table_field_muls - tbl0) / n,
+                "ladders_per_sec": n / dt,
+                "decompositions": c.glv_decompositions,
+            }
+        finally:
+            if saved is None:
+                os.environ.pop("HBBFT_TPU_NO_GLV", None)
+            else:
+                os.environ["HBBFT_TPU_NO_GLV"] = saved
+            if saved_bin is not None:
+                os.environ["HBBFT_TPU_LADDER_BINARY"] = saved_bin
+
+    glv = arm(no_glv=False)
+    w2 = arm(no_glv=True)
+    assert glv["decompositions"] > 0, "GLV arm never decomposed — vacuous A/B"
+    assert w2["decompositions"] == 0, "kill switch leaked into the w2 arm"
+    return {
+        "metric": "glv_ladder_ab",
+        "value": round(glv["ladders_per_sec"], 2),
+        "unit": "ladders/s",
+        "batch": batch,
+        "field_muls_per_ladder_glv": round(glv["muls_per_ladder"], 1),
+        "field_muls_per_ladder_w2": round(w2["muls_per_ladder"], 1),
+        "field_mul_reduction": round(
+            w2["muls_per_ladder"] / glv["muls_per_ladder"], 3
+        ),
+        "table_muls_per_ladder": round(glv["table_muls_per_ladder"], 1),
+        "w2_ladders_per_sec": round(w2["ladders_per_sec"], 2),
+        "glv_vs_w2": round(
+            glv["ladders_per_sec"] / w2["ladders_per_sec"], 3
+        ),
     }
 
 
@@ -1366,7 +1455,7 @@ _BENCH_EST_S = {
     "array_n100_tpu": 1200, "rs_encode": 120, "rs_host": 60,
     "fq_kernel": 240, "n4": 60, "n4_realcrypto": 300, "n100": 420,
     "array_n256_soak": 300, "array_n100_dedup": 120, "array_n64_coin": 240,
-    "array_n100": 300,
+    "array_n100": 300, "glv_ladder": 180,
 }
 
 
@@ -1403,6 +1492,8 @@ def _plan_benches(only, platform: str, budget: float) -> list:
             plan.append(("array_n16_tpu", bench_array_engine_n16_tpu))
             if platform == "tpu":
                 plan.append(("array_n100_tpu", bench_array_engine_n100_tpu))
+        # diagnostic A/B row — after the flagship prefix, before support
+        plan.append(("glv_ladder", bench_glv_ladder))
         plan += [("rs_encode", bench_rs_encode), ("rs_host", bench_rs_host)]
         if fqk:
             plan.append(("fq_kernel", bench_fq_kernel))
@@ -1439,6 +1530,7 @@ def _plan_benches(only, platform: str, budget: float) -> list:
             ("g2_sign", bench_g2_sign),
             ("coin_e2e", bench_coin_e2e),
             ("rlc_dec_adversarial", bench_rlc_dec_adversarial),
+            ("glv_ladder", bench_glv_ladder),
         ]
         if fqk:
             plan.append(("fq_kernel", bench_fq_kernel))

@@ -229,3 +229,76 @@ class DeterminismRule(Rule):
                     if why is not None:
                         emit(node, f"{why} in a comprehension; wrap in sorted(...)")
         return findings
+
+
+@register
+class GlvTableOrderRule(Rule):
+    """Determinism-family guard for the GLV/GLS joint-table build.
+
+    The 16-entry joint tables in ``ops/curve.py`` (``_joint_table*``)
+    define the gather layout of every endomorphism ladder: entry idx must
+    mean the SAME window combination in every process, or replayed runs
+    and the ``HBBFT_TPU_NO_GLV`` A/B stop being bit-identical.  The build
+    must therefore iterate window indices in a fixed arithmetic order —
+    every ``for`` loop and comprehension inside a ``_joint_table*``
+    function is required to iterate a literal ``range(...)`` (sets,
+    dicts, ``.values()``/``.items()`` and arbitrary iterables are all
+    rejected, not merely the provably-unordered ones: the table layout
+    is load-bearing enough to pin the idiom, not just the semantics).
+    The rule also fails when NO ``_joint_table*`` function exists, so a
+    rename or deletion cannot silently retire the guard.
+    """
+
+    rule_id = "glv-table-order"
+    scope = ("hbbft_tpu/ops/curve.py",)
+
+    def check_module(self, mod: ModuleSource) -> List[Finding]:
+        findings: List[Finding] = []
+
+        def emit(node: ast.AST, message: str) -> None:
+            findings.append(
+                Finding(
+                    self.rule_id,
+                    mod.path,
+                    getattr(node, "lineno", 1),
+                    getattr(node, "col_offset", 0),
+                    message,
+                )
+            )
+
+        def is_range_call(it: ast.AST) -> bool:
+            return (
+                isinstance(it, ast.Call)
+                and isinstance(it.func, ast.Name)
+                and it.func.id == "range"
+            )
+
+        fns = [
+            n
+            for n in ast.walk(mod.tree)
+            if isinstance(n, ast.FunctionDef) and n.name.startswith("_joint_table")
+        ]
+        if not fns:
+            emit(
+                mod.tree,
+                "no _joint_table* function found: the joint-table build "
+                "(and its fixed-order guard) is missing from ops/curve.py",
+            )
+        for fn in fns:
+            for node in ast.walk(fn):
+                iters: List[ast.AST] = []
+                if isinstance(node, ast.For):
+                    iters.append(node.iter)
+                elif isinstance(
+                    node, (ast.ListComp, ast.SetComp, ast.DictComp, ast.GeneratorExp)
+                ):
+                    iters.extend(c.iter for c in node.generators)
+                for it in iters:
+                    if not is_range_call(it):
+                        emit(
+                            it,
+                            f"table precomputation in {fn.name}() must "
+                            "iterate window indices via range(...); found a "
+                            "non-range iterable",
+                        )
+        return findings

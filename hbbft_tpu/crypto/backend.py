@@ -246,8 +246,11 @@ class CryptoBackend(abc.ABC):
 
     def g1_lincomb(self, scalars: Sequence[int], points: Sequence[Any]) -> Any:
         """One multi-scalar combination Σ s_i·P_i — the aggregated side of
-        the DKG's RLC commitment checks (one MSM replaces N³ per-item
-        Horner evaluations).  Default: batched muls + host fold."""
+        the DKG's RLC commitment checks and era-change cross-checks (one
+        MSM replaces N³ per-item Horner evaluations).  Default: batched
+        muls + host fold; TpuBackend overrides with a single
+        linear_combine_g1 dispatch per lane-capped chunk, riding the
+        GLV joint-table ladder (ops/backend.py)."""
         g = self.group
         acc = g.g1_identity()
         for el in self.g1_mul_batch(scalars, points):

@@ -77,6 +77,23 @@ def _bucket(n: int) -> int:
     return b
 
 
+def _pad_zero_rows(bits, negs, pad: int):
+    """Pad prepared ladder rows with zero-scalar lanes (0·∞ contributes
+    the identity).  A zero scalar's bit rows and sign flags are all-zero
+    in BOTH the classic and the GLV-decomposed forms, so zero-fill is
+    exactly equivalent to decomposing the padding scalars — without
+    billing phantom Babai decompositions to the GLV counters."""
+    if pad <= 0:
+        return bits, negs
+    bits = np.concatenate(
+        [bits, np.zeros((pad,) + bits.shape[1:], dtype=bits.dtype)]
+    )
+    negs = np.concatenate(
+        [negs, np.zeros((pad,) + negs.shape[1:], dtype=negs.dtype)]
+    )
+    return bits, negs
+
+
 @functools.lru_cache(maxsize=None)
 def _jitted_product2():
     """(P1, Q1, P2, Q2) → fq12 limbs of FE_fast(ML(P1,Q1)·ML(P2,Q2))."""
@@ -220,6 +237,40 @@ class TpuBackend(CryptoBackend):
         """Bucket size for a batch/group axis.  MeshBackend widens this
         to a multiple of the mesh so the axis shards evenly."""
         return _bucket(n)
+
+    def _prep_scalars(self, prep, scalars):
+        """Run a curve.prep_g*_scalars host prep under the GLV accounting
+        counters: decomposition+packing wall time (the host share of the
+        joint-table path) bills to glv_table_build_seconds, and the
+        decomposition tally to glv_decompositions."""
+        t0 = time.perf_counter()
+        bits, negs = prep(scalars)
+        if curve.glv_enabled():
+            c = self.counters
+            c.glv_table_build_seconds += time.perf_counter() - t0
+            c.glv_decompositions += len(scalars)
+        return bits, negs
+
+    def _count_ladder(
+        self, bits, lanes: int, glv: bool, ladders_per_lane: int = 1
+    ) -> None:
+        """Analytic ladder accounting: `ladder_field_muls` accumulates the
+        per-lane scan cost of the prepared bit matrix × lanes (Fq muls on
+        G1 shapes, Fq2 muls on G2 — documented in utils/metrics.py), plus
+        the per-lane joint-table build under GLV.  ``glv`` is passed
+        explicitly by the call site (a decomposed bit matrix and an RLC
+        (groups, k, 64) matrix can be shape-identical at k ∈ {2, 4}).
+        ``ladders_per_lane`` is for graphs that run several ladders over
+        one bit row (the RLC group check ladders both the share and the
+        key combination)."""
+        c = self.counters
+        c.ladder_field_muls += (
+            curve.ladder_scan_field_muls(bits, glv) * lanes * ladders_per_lane
+        )
+        if glv:
+            c.glv_table_field_muls += (
+                curve.glv_table_field_muls(bits) * lanes * ladders_per_lane
+            )
 
     def _place(self, tree):
         """Placement hook for jitted-call inputs (MeshBackend shards)."""
@@ -446,6 +497,13 @@ class TpuBackend(CryptoBackend):
 
                 args = build_group_arrays(padded, g, k)
                 placed = self._place(tuple(args) + (jnp.asarray(rbits),))
+            # two RLC_BITS-wide w2 ladders per lane (share + key combine);
+            # the 64-bit coefficients stay on the classic path — GLV
+            # decomposition has nothing to split below 2^127
+            self._count_ladder(
+                rbits, sum(len(grp) for grp in pending), glv=False,
+                ladders_per_lane=2,
+            )
             self.counters.rlc_groups += len(pending)
             self.counters.device_dispatches += 1
             f = self._dispatch_fetch(
@@ -644,36 +702,49 @@ class TpuBackend(CryptoBackend):
     # -- combination ---------------------------------------------------------
 
     def _lagrange_device(
-        self, pts: List[Tuple[int, Any]], to_device, from_device, jitted
+        self, pts: List[Tuple[int, Any]], to_device, from_device, jitted, prep
     ):
         """Shared padding/bucketing for device Lagrange combines.
 
         Pads with infinity points and zero scalars (0·∞ contributes the
-        identity) up to a power-of-two bucket so XLA compiles few shapes.
+        identity; a zero scalar decomposes to all-zero GLV halves) up to
+        a power-of-two bucket so XLA compiles few shapes.  ``prep`` is
+        the group's curve.prep_g*_scalars — it picks the GLV joint-table
+        or classic safe_scalar ladder form.
         """
         with self._host_assembly():
             lam = lagrange_coeffs_at_zero([x for x, _ in pts])
-            safe = [curve.safe_scalar(l) for l in lam]
-            b = _bucket(len(pts))
-            points = [el for _, el in pts] + [None] * (b - len(pts))
-            bits = curve.scalars_to_bits(
-                [s for s, _ in safe] + [0] * (b - len(pts))
+            args = self._stage_combine(
+                lam, [el for _, el in pts], to_device, prep
             )
-            negs = np.array([n for _, n in safe] + [False] * (b - len(pts)))
-            args = (to_device(points), bits, negs)
         combined = self._dispatch_fetch(
             jitted, args, kind="combine", items=len(pts),
         )
         return from_device(combined)[0]
 
+    def _stage_combine(self, coeffs, point_els, to_device, prep):
+        """Shared staging core of the single-combine and MSM-chunk
+        dispatches: bucket-pad points with ∞ and coefficients with
+        zero-scalar rows (identity contributions), prep through the
+        GLV/classic seam, and bill the ladder accounting — ONE place so
+        pad rules and counters cannot drift between the paths."""
+        b = _bucket(len(point_els))
+        pts = list(point_els) + [None] * (b - len(point_els))
+        bits, negs = self._prep_scalars(prep, list(coeffs))
+        bits, negs = _pad_zero_rows(bits, negs, b - len(point_els))
+        self._count_ladder(bits, len(point_els), glv=bits.ndim == 3)
+        return (to_device(pts, cache=self._stage), bits, negs)
+
     def _lagrange_device_g2(self, pts: List[Tuple[int, Any]]):
         return self._lagrange_device(
-            pts, curve.g2_to_device, curve.g2_from_device, _jitted_combine_g2()
+            pts, curve.g2_to_device, curve.g2_from_device,
+            _jitted_combine_g2(), curve.prep_g2_scalars,
         )
 
     def _lagrange_device_g1(self, pts: List[Tuple[int, Any]]):
         return self._lagrange_device(
-            pts, curve.g1_to_device, curve.g1_from_device, _jitted_combine_g1()
+            pts, curve.g1_to_device, curve.g1_from_device,
+            _jitted_combine_g1(), curve.prep_g1_scalars,
         )
 
     def combine_signatures(
@@ -776,21 +847,26 @@ class TpuBackend(CryptoBackend):
             curve.g1_to_device,
             _jitted_combine_g1_batch(),
             deliver,
+            curve.prep_g1_scalars,
         )
 
     def _ladder_batch(self, scalars, points, host_fn, to_device,
-                      from_device, jitted, kind=""):
+                      from_device, jitted, prep, kind=""):
         """Shared body of the batched independent-ladder dispatches
         (decrypt-share generation in G1, coin-share signing in G2):
         threshold gate → lane-capped pipelined chunk loop → bucket pad →
         deferred-fetch dispatch per chunk → unwrap.
 
+        ``prep`` (curve.prep_g1_scalars / prep_g2_scalars) turns the
+        chunk's scalars into the ladder bit form — GLV/GLS-decomposed
+        joint-table windows by default, classic safe_scalar bits under
+        ``HBBFT_TPU_NO_GLV=1``; outputs are bit-identical either way.
         ``host_fn(i)`` is the per-item host golden below the threshold;
         it also serves a trailing chunk that falls below the threshold
         (n == cap + small tail), exactly as the pre-pipeline recursion
-        did.  Chunk k+1's staging (scalars_to_bits + point conversion)
-        overlaps chunk k's device execution; each chunk's deferred fetch
-        delivers into its own slice of ``out``."""
+        did.  Chunk k+1's staging (decomposition + bit packing + point
+        conversion) overlaps chunk k's device execution; each chunk's
+        deferred fetch delivers into its own slice of ``out``."""
         n = len(scalars)
         if n < self.device_combine_threshold:
             return [host_fn(i) for i in range(n)]
@@ -804,26 +880,26 @@ class TpuBackend(CryptoBackend):
                 continue
             self._submit_ladder_chunk(
                 scalars[lo:hi], points[lo:hi], lo, out,
-                to_device, from_device, jitted, kind,
+                to_device, from_device, jitted, prep, kind,
             )
         self._pipe.flush()
         return out
 
     def _submit_ladder_chunk(self, scalars, points, base, out,
-                             to_device, from_device, jitted, kind) -> None:
+                             to_device, from_device, jitted, prep,
+                             kind) -> None:
         n = len(scalars)
         with self._host_assembly():
             b = self._pad_bucket(n)
-            safe = [curve.safe_scalar(s) for s in scalars]
-            bits = curve.scalars_to_bits([s for s, _ in safe])
-            negs = np.array([neg for _, neg in safe])
+            bits, negs = self._prep_scalars(prep, list(scalars))
             pts = list(points)
             if b > n:
                 bits = np.concatenate([bits, np.repeat(bits[:1], b - n, axis=0)])
-                negs = np.concatenate([negs, np.repeat(negs[:1], b - n)])
+                negs = np.concatenate([negs, np.repeat(negs[:1], b - n, axis=0)])
                 pts = pts + [pts[0]] * (b - n)
             P = to_device(pts, cache=self._stage)
             placed = self._place((P, jnp.asarray(bits), jnp.asarray(negs)))
+        self._count_ladder(bits, n, glv=bits.ndim == 3)
         self.counters.device_dispatches += 1
 
         def deliver(fetched, base=base, n=n):
@@ -853,6 +929,7 @@ class TpuBackend(CryptoBackend):
             curve.g2_to_device,
             curve.g2_from_device,
             _jitted_g2_mul_batch(),
+            curve.prep_g2_scalars,
             kind="sign",
         )
         return [
@@ -934,10 +1011,12 @@ class TpuBackend(CryptoBackend):
             step = floor
         return step
 
-    def _lagrange_chunk(self, share_dicts, k, to_device, jitted, on_result):
+    def _lagrange_chunk(self, share_dicts, k, to_device, jitted, on_result,
+                        prep):
         """Shared chunk body for the batched Lagrange combines: (B, k)
-        point tree + per-item coefficient bit/neg rows, padded with copies
-        of the first item (discarded) to a power-of-two item bucket.
+        point tree + per-item coefficient bit/neg rows (GLV-decomposed by
+        default — ``prep`` picks the form), padded with copies of the
+        first item (discarded) to a power-of-two item bucket.
 
         The dispatch is pipelined: ``on_result(fetched)`` is called from
         the deferred fetch while later chunks assemble; the caller
@@ -950,10 +1029,10 @@ class TpuBackend(CryptoBackend):
             for shares in share_dicts:
                 srt = sorted(shares.items())
                 lam = lagrange_coeffs_at_zero([i + 1 for i, _ in srt])
-                safe = [curve.safe_scalar(l) for l in lam]
                 flat_pts.extend(s.el for _, s in srt)
-                bits_rows.append(curve.scalars_to_bits([s for s, _ in safe]))
-                negs_rows.append([n for _, n in safe])
+                row_bits, row_negs = self._prep_scalars(prep, lam)
+                bits_rows.append(row_bits)
+                negs_rows.append(row_negs)
             pad = b - len(share_dicts)
             flat_pts.extend(flat_pts[:k] * pad)
             bits_rows.extend([bits_rows[0]] * pad)
@@ -963,8 +1042,12 @@ class TpuBackend(CryptoBackend):
                 lambda c: jnp.reshape(c, (b, k) + c.shape[1:]), P
             )
             bits = jnp.asarray(np.stack(bits_rows))
-            negs = jnp.asarray(np.array(negs_rows))
+            negs = jnp.asarray(np.stack(negs_rows))
             placed = self._place((P, bits, negs))
+        # bits_rows[0] is the host numpy prep output — shape/ndim only
+        self._count_ladder(
+            bits_rows[0], len(share_dicts) * k, glv=bits_rows[0].ndim == 3,
+        )
         self.counters.device_dispatches += 1
         return self._dispatch_async(
             jitted, placed, kind="combine", items=len(share_dicts),
@@ -983,6 +1066,7 @@ class TpuBackend(CryptoBackend):
             curve.g2_to_device,
             _jitted_combine_g2_batch(),
             deliver,
+            curve.prep_g2_scalars,
         )
 
     def decrypt_shares_batch(
@@ -1005,6 +1089,7 @@ class TpuBackend(CryptoBackend):
             curve.g1_to_device,
             curve.g1_from_device,
             _jitted_g1_mul_batch(),
+            curve.prep_g1_scalars,
             kind="decrypt",
         )
         return [
@@ -1030,8 +1115,61 @@ class TpuBackend(CryptoBackend):
             curve.g1_to_device,
             curve.g1_from_device,
             _jitted_g1_mul_batch(),
+            curve.prep_g1_scalars,
             kind=kind,
         )
+
+    def g1_lincomb(self, scalars: Sequence[int], points: Sequence[Any]) -> Any:
+        """One device MSM Σ s_i·P_i — the aggregated side of the batched
+        DKG's RLC commitment cross-checks and era-change consistency
+        checks (engine/dkg_batch.py feeds N²-sized point sets here).
+
+        Above the combine threshold this is a single linear_combine_g1
+        dispatch per lane-capped chunk riding the GLV joint-table ladder
+        (the base class falls back to batched muls + host fold, which
+        costs a per-point host add and a full readback).  Chunks are
+        PIPELINED like every other lane-capped loop here: chunk k+1's
+        decomposition + staging overlaps chunk k's device execution, and
+        the ≤ n/device_lane_cap partial sums fold on host after the
+        flush.
+
+        Precondition (as for g1_mul_batch): points have order r."""
+        n = len(scalars)
+        if n < self.device_combine_threshold:
+            return super().g1_lincomb(scalars, points)
+        cap = self.device_lane_cap
+        partials: List[Any] = [None] * ((n + cap - 1) // cap)
+        for ci, lo in enumerate(range(0, n, cap)):
+            chunk_s = list(scalars[lo : lo + cap])
+            chunk_p = list(points[lo : lo + cap])
+            if len(chunk_s) < self.device_combine_threshold:
+                # sub-threshold tail chunk: host fold, as _ladder_batch
+                # does — a device round-trip for a couple of scalars
+                # costs more than it saves
+                acc_h = None
+                for s, p in zip(chunk_s, chunk_p):
+                    acc_h = self.group.g1_add(acc_h, self.group.g1_mul(s, p))
+                partials[ci] = acc_h
+                continue
+            with self._host_assembly():
+                args = self._stage_combine(
+                    chunk_s, chunk_p, curve.g1_to_device,
+                    curve.prep_g1_scalars,
+                )
+            self.counters.device_dispatches += 1
+
+            def deliver(fetched, ci=ci):
+                partials[ci] = curve.g1_from_device(fetched)[0]
+
+            self._dispatch_async(
+                _jitted_combine_g1(), args, kind="dkg", items=len(chunk_s),
+                on_result=deliver,
+            )
+        self._pipe.flush()
+        acc = partials[0]
+        for el in partials[1:]:
+            acc = self.group.g1_add(acc, el)
+        return acc
 
     def g2_mul_batch(
         self, scalars: Sequence[int], points: Sequence[Any], kind: str = "dkg"
@@ -1044,6 +1182,7 @@ class TpuBackend(CryptoBackend):
             curve.g2_to_device,
             curve.g2_from_device,
             _jitted_g2_mul_batch(),
+            curve.prep_g2_scalars,
             kind=kind,
         )
 

@@ -11,18 +11,37 @@ Design constraints from the TPU mapping (SURVEY.md §7):
 * **Fixed control flow.**  Scalar multiplication is a 254-iteration
   MSB-first double-and-add-always ladder under `lax.scan` — one compiled
   graph for every scalar, batch-friendly, constant-time by construction.
-* **Unequal-add only.**  The Jacobian add assumes P ≠ ±Q for finite
-  operands.  Inside the ladder acc = 2m·P meets ±P only when 2m ≡ ±1
-  (mod r), which is impossible for scalars < 2^254 (see `safe_scalar`) —
-  the degenerate case is structurally excluded, not probabilistically.
-  For share combination the added points are distinct verified shares whose
-  discrete logs were fixed before the (public) Lagrange coefficients were
-  known, so an accidental ±collision has cryptographically negligible
-  probability; signature combines are additionally re-verified against the
-  master public key by the backend (defense in depth with CPU fallback).
+* **Unequal-add on the classic ladders, complete add on the table paths.**
+  The plain Jacobian add assumes P ≠ ±Q for finite operands; the binary
+  and w2 ladders carry structural proofs that the degenerate case cannot
+  occur (see `safe_scalar` / `_scalar_mul_w2`).  The GLV/GLS joint-table
+  ladders (`_scalar_mul_joint`) CANNOT carry such a proof — the short
+  lattice vectors put decomposed coordinates inside the prefix ranges, so
+  adversarial scalars reach acc = ±T mid-ladder — and therefore use
+  `jac_add(..., complete=True)`: a select-routed complete addition whose
+  doubling/infinity routes are driven by exact in-graph zero tests
+  (fq.is_zero).  For share combination the added points are distinct
+  verified shares whose discrete logs were fixed before the (public)
+  Lagrange coefficients were known, so an accidental ±collision has
+  cryptographically negligible probability; signature combines are
+  additionally re-verified against the master public key by the backend
+  (defense in depth with CPU fallback).
+* **GLV/GLS endomorphism decomposition** (default; ``HBBFT_TPU_NO_GLV=1``
+  reverts).  G1: k = k1 + λ·k2 with |k1|,|k2| ≤ 2^127 via exact-fraction
+  Babai rounding on the basis (−λ, 1), (1, λ+1) (det −r; λ² + λ + 1 = r
+  exactly for BLS12-381), φ(x, y) = (β·x, y) one lane-constant multiply.
+  G2: 4-way GLS k = Σ k_j·u^j with |k_j| < 2^63 over the ψ (twist
+  Frobenius) eigenvalue u, ψ applied as conjugate + two lane-constant
+  Fq2 multiplies.  Both run a 16-entry per-lane joint table ({Σ w_j·P_j}
+  over 2-bit/1-bit windows of every half/quarter) through a 64-step
+  gather-based Shamir ladder: 2368 ladder field-muls per G1 ladder vs
+  3810 on the w2 path (~1.6×), 1920 Fq2-muls per G2 ladder vs 3810 (~2×).
+  Outputs are bit-identical to the w2/binary ladders either way.
 
 Reference analogue: group ops inside `threshold_crypto`'s `pairing` crate
-(SURVEY.md §2.2) — serial Rust there, batched limb vectors here.
+(SURVEY.md §2.2) — serial Rust there, batched limb vectors here; the
+endomorphism playbook follows Gallant–Lambert–Vanstone (CRYPTO 2001) and
+Galbraith–Lindell–Scott (J. Cryptology 2011) as deployed in blst.
 """
 
 from __future__ import annotations
@@ -35,10 +54,13 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from hbbft_tpu.crypto import bls381 as _gold
 from hbbft_tpu.crypto.field import R
 from hbbft_tpu.ops import fq, tower
 
 SCALAR_BITS = 254  # scalars are screened to < 2^254 (see safe_scalar)
+GLV_HALF_BITS = 128  # |k1|,|k2| ≤ 2^127 (Babai bound, property-tested)
+GLS_QUARTER_BITS = 64  # |k_j| < 2^63 for the 4-way G2 split
 
 
 # ---------------------------------------------------------------------------
@@ -56,6 +78,19 @@ class _F1:
     sqr = staticmethod(fq.sqr)
     mul_many = staticmethod(fq.mul_n)
     select = staticmethod(fq.select)
+    is_zero = staticmethod(fq.is_zero)
+
+    @staticmethod
+    def is_zero_pair(a, b):
+        """Both zero tests as ONE stacked probe (XLA compile time scales
+        with dot_general count — same motivation as fq.mul_n)."""
+        z = fq.is_zero(jnp.stack([a, b]))
+        return z[0], z[1]
+
+    @staticmethod
+    def endo(P):
+        """φ(x, y) = (β·x, y) — multiplication by λ on the r-subgroup."""
+        return _phi_g1(P)
 
     @staticmethod
     def zeros_like(x):
@@ -77,6 +112,18 @@ class _F2:
     sqr = staticmethod(tower.fq2_sqr)
     mul_many = staticmethod(tower.fq2_mul_many)
     select = staticmethod(tower.fq2_select)
+    is_zero = staticmethod(tower.fq2_is_zero)
+
+    @staticmethod
+    def is_zero_pair(a, b):
+        """All four component zero-probes as ONE stacked fq.is_zero."""
+        z = fq.is_zero(jnp.stack([a[0], a[1], b[0], b[1]]))
+        return z[0] & z[1], z[2] & z[3]
+
+    @staticmethod
+    def endo(P):
+        """ψ = twist∘Frobenius∘untwist — multiplication by u on G2."""
+        return _psi_g2(P)
 
     @staticmethod
     def zeros_like(x):
@@ -113,8 +160,41 @@ def jac_double(F, P):
     return (X3, Y3, Z3, inf)
 
 
-def jac_add(F, P, Qp):
-    """Unequal add (P ≠ ±Q where both finite); infinity handled by select."""
+def jac_add(F, P, Qp, complete=False):
+    """Jacobian add; infinity handled by select.
+
+    ``complete=False`` (default): unequal add — requires P ≠ ±Q where both
+    are finite; the classic-ladder call sites carry structural proofs of
+    that precondition (see `safe_scalar` / `_scalar_mul_w2`).
+
+    ``complete=True``: select-routed COMPLETE addition, used on every
+    joint-table path (table build and table-ladder accumulator adds),
+    where adversarial scalars can reach the degenerate cases.  Exhaustive
+    case split — with U1 = X1·Z2², U2 = X2·Z1², S1 = Y1·Z2³, S2 = Y2·Z1³
+    and the exact in-graph zero tests H = U2−U1 ≡ 0, Rr = S2−S1 ≡ 0
+    (fq.is_zero; sound and complete within the documented lazy-value
+    domain, which every operand here satisfies as a difference of fresh
+    mul outputs):
+
+    1. inf1           → result Q (the inf selects below, either mode).
+    2. inf2           → result P (ditto).
+    3. finite, H ≠ 0             → P ≠ ±Q: the unequal formula is valid.
+    4. finite, H = 0, Rr = 0     → U1=U2, S1=S2 ⟺ P = Q (Jacobian
+       equality is exactly the cross-multiplied coordinate equality):
+       route to jac_double(P), which is total (no excluded inputs; the
+       y = 0 self-inverse case would need a 2-torsion point, and the
+       order-r subgroup of BLS12-381 has none since r is odd).
+    5. finite, H = 0, Rr ≠ 0     → x-coords equal, y-coords differ ⟺
+       Q = −P: route to the canonical infinity lanes (0, 1, 0, inf=True).
+       (y1 = −y2 AND y1 = y2 would again need 2-torsion — cases 4/5 are
+       mutually exclusive for order-r inputs.)
+
+    Degenerate lanes under ``complete=False`` produce finite-residue
+    garbage (never NaN/Inf — the formulas are polynomial), which callers
+    must discard by select; under ``complete=True`` every case returns
+    the correct point."""
+    if complete:
+        return _jac_add_complete(F, P, Qp)
     X1, Y1, Z1, inf1 = P
     X2, Y2, Z2, inf2 = Qp
     Z1Z1, Z2Z2, Y1Z2, Y2Z1, Z1Z2 = F.mul_many(
@@ -136,6 +216,66 @@ def jac_add(F, P, Qp):
     Y3 = F.select(inf1, Y2, F.select(inf2, Y1, Y3))
     Z3 = F.select(inf1, Z2, F.select(inf2, Z1, Z3))
     return (X3, Y3, Z3, inf1 & inf2)
+
+
+def _jac_add_complete(F, P, Qp):
+    """jac_add's ``complete=True`` body (see its docstring for the
+    exhaustive case split).  The doubling route's products are
+    interleaved into the unequal-add's stacked multiply levels — the two
+    routes' formulas are level-parallel, so completeness costs the same
+    5 mul_many dispatch sites as the plain add (XLA compile time scales
+    with dot_general count; fq.mul_n note) instead of 5 + jac_double's 3.
+    Field-mul totals are unchanged (23 per lane)."""
+    X1, Y1, Z1, inf1 = P
+    X2, Y2, Z2, inf2 = Qp
+    # L1: add inputs + doubling stage 1 (A, B, YZ of jac_double on P)
+    Z1Z1, Z2Z2, Y1Z2, Y2Z1, Z1Z2, dA, dB, dYZ = F.mul_many(
+        [
+            (Z1, Z1), (Z2, Z2), (Y1, Z2), (Y2, Z1), (Z1, Z2),
+            (X1, X1), (Y1, Y1), (Y1, Z1),
+        ]
+    )
+    dE = F.add(F.add(dA, dA), dA)  # 3A
+    # L2: U/S cross terms + doubling stage 2 (C, t, F of jac_double)
+    U1, U2, S1, S2, dC, dt, dFv = F.mul_many(
+        [
+            (X1, Z2Z2), (X2, Z1Z1), (Y1Z2, Z2Z2), (Y2Z1, Z1Z1),
+            (dB, dB), (F.add(X1, dB), F.add(X1, dB)), (dE, dE),
+        ]
+    )
+    H = F.sub(U2, U1)
+    Rr = F.sub(S2, S1)
+    dD = F.add(F.sub(F.sub(dt, dA), dC), F.sub(F.sub(dt, dA), dC))
+    dX3 = F.sub(dFv, F.add(dD, dD))
+    # L3: H²/Z3 + doubling stage 3 (E·(D−X3))
+    H2, Z3, dEDX = F.mul_many([(H, H), (Z1Z2, H), (dE, F.sub(dD, dX3))])
+    dC4 = F.add(F.add(dC, dC), F.add(dC, dC))
+    dY3 = F.sub(dEDX, F.add(dC4, dC4))
+    dZ3 = F.add(dYZ, dYZ)
+    # L4/L5: the unequal-add tail
+    H3, U1H2, R2 = F.mul_many([(H, H2), (U1, H2), (Rr, Rr)])
+    X3 = F.sub(F.sub(R2, H3), F.add(U1H2, U1H2))
+    RY, S1H3 = F.mul_many([(Rr, F.sub(U1H2, X3)), (S1, H3)])
+    Y3 = F.sub(RY, S1H3)
+
+    eq_x, eq_y = F.is_zero_pair(H, Rr)
+    fin = ~inf1 & ~inf2
+    use_dbl = fin & eq_x & eq_y
+    to_inf = fin & eq_x & ~eq_y
+    X3 = F.select(use_dbl, dX3, X3)
+    Y3 = F.select(use_dbl, dY3, Y3)
+    Z3 = F.select(use_dbl, dZ3, Z3)
+
+    # inf1 → Q ; inf2 → P ; both → inf
+    X3 = F.select(inf1, X2, F.select(inf2, X1, X3))
+    Y3 = F.select(inf1, Y2, F.select(inf2, Y1, Y3))
+    Z3 = F.select(inf1, Z2, F.select(inf2, Z1, Z3))
+    # canonical infinity lanes: bounded coordinates keep later zero-test
+    # operands inside their documented value domain
+    X3 = F.select(to_inf, F.zeros_like(X3), X3)
+    Y3 = F.select(to_inf, F.one_like(Y3), Y3)
+    Z3 = F.select(to_inf, F.zeros_like(Z3), Z3)
+    return (X3, Y3, Z3, (inf1 & inf2) | to_inf)
 
 
 def jac_neg(F, P):
@@ -198,7 +338,9 @@ def _scalar_mul_w2(F, bits: jnp.ndarray, P):
     with 1 per 2 bits: ~25% fewer point-ops than the binary ladder AND
     half the per-step scan overhead (the dominant cost at RLC widths).
 
-    Unequal-add safety (same style as safe_scalar's argument): before a
+    Unequal-add safety (same style as safe_scalar's argument; COVERS THIS
+    w2 LADDER ONLY — the GLV/GLS joint-table ladder gets no such proof
+    and uses complete adds instead, see `_scalar_mul_joint`): before a
     window the accumulator is 4m·P with prefix m < 2^252 (a safe_scalar
     input has < 2^254 bits, so the prefix before the last window is at
     most 2^252−1).  A degenerate add needs 4m ≡ ±w (mod r) for the
@@ -233,6 +375,211 @@ def _scalar_mul_w2(F, bits: jnp.ndarray, P):
 
 
 # ---------------------------------------------------------------------------
+# GLV/GLS endomorphism ladders: device side.
+#
+# The endomorphism constants self-validated in crypto/bls381.py at import
+# (φ(G1) == λ·G1, ψ(G2) == u·G2); if either resolution failed the GLV
+# path is disabled wholesale (glv_enabled) and the w2 ladders carry on.
+# ---------------------------------------------------------------------------
+
+_BETA_ROW = (
+    fq.from_int(_gold._BETA) if _gold._BETA is not None else None
+)
+_PSI_CX = (
+    tower.fq2_from_ints(_gold._PSI_CONSTS[0])
+    if _gold._PSI_CONSTS is not None
+    else None
+)
+_PSI_CY = (
+    tower.fq2_from_ints(_gold._PSI_CONSTS[1])
+    if _gold._PSI_CONSTS is not None
+    else None
+)
+
+
+def _phi_g1(P):
+    """G1 endomorphism φ in Jacobian coordinates: (β·X, Y, Z).
+
+    Affine check: x = X/Z² ↦ β·X/Z² = β·x, y unchanged — exactly
+    φ(x, y) = (β·x, y).  One lane-constant field multiply."""
+    X, Y, Z, inf = P
+    (bX,) = fq.mul_n([(jnp.asarray(_BETA_ROW), X)])
+    return (bX, Y, Z, inf)
+
+
+def _psi_g2(P):
+    """G2 endomorphism ψ in Jacobian coordinates:
+    (c_x·X̄, c_y·Ȳ, Z̄) with σ the Fq2 conjugation (Frobenius).
+
+    Affine check: x = X/Z² ↦ c_x·X̄/Z̄² = c_x·σ(x) (σ is a field
+    automorphism), matching bls381._psi.  Conjugation is a sign flip;
+    the two constant Fq2 multiplies are 6 stacked Fq muls."""
+    X, Y, Z, inf = P
+    cx = tuple(jnp.asarray(c) for c in _PSI_CX)
+    cy = tuple(jnp.asarray(c) for c in _PSI_CY)
+    Xp, Yp = tower.fq2_mul_many(
+        [(cx, tower.fq2_conj(X)), (cy, tower.fq2_conj(Y))]
+    )
+    return (Xp, Yp, tower.fq2_conj(Z), inf)
+
+
+def _stack_points(pts):
+    """Stack identical-structure points along a new leading batch axis
+    (the field ops are batch-agnostic, so one point-op over the stack
+    replaces len(pts) separate ops — XLA compile time scales with the
+    dot_general count, the fq.mul_n motivation)."""
+    return jax.tree_util.tree_map(lambda *cs: jnp.stack(cs), *pts)
+
+
+def _index_point(P, i: int):
+    return jax.tree_util.tree_map(lambda c: c[i], P)
+
+
+def _joint_table(F, parts, digit_base: int):
+    """The 16-entry per-lane joint table T[idx] = Σ_j digit_j(idx)·parts[j]
+    with idx = Σ_j digit_j·digit_base^j, digit_base^len(parts) == 16.
+
+    Construction iterates digits/indices over ``range(...)`` only — a
+    FIXED order (the ``glv-table-order`` lint rule pins this: a
+    nondeterministic build order would compile a different gather layout
+    per process and break replay/A-B bit-identity).  Per-part multiple
+    chains d·parts[j] come first (one stacked doubling + one stacked
+    complete add across all parts), then each further part folds into the
+    running table with ONE stacked complete add covering every (d, prev)
+    combination — 2–3 stacked point-ops total instead of 11+ sequential
+    ones, with identical per-lane arithmetic (the stack axis is just
+    batch).
+
+    Complete adds throughout: entry collisions (w·P_i = ±w'·P_j) are
+    excluded only by curve-specific eigenvalue-magnitude arguments
+    (λ ≫ 3 in G1; no vanishing ±u^j subset sum in G2), not by the
+    ladder-structural proof the classic paths carry — and this module's
+    safety contract is that every table path is complete rather than
+    argued case-by-case.
+    """
+    m = len(parts)
+    if digit_base == 4:
+        S = _stack_points(parts)
+        D2 = jac_double(F, S)  # 2·parts[j], total — no degenerate case
+        D3 = jac_add(F, D2, S, complete=True)  # 3·parts[j]
+        chains = [
+            [None, parts[j], _index_point(D2, j), _index_point(D3, j)]
+            for j in range(m)
+        ]
+    else:
+        chains = [[None, parts[j]] for j in range(m)]
+    entries = [infinity_like(F, parts[0])] + chains[0][1:]
+    for j in range(1, m):
+        base = digit_base**j
+        prev = list(entries)  # covers idx ∈ [0, base)
+        for d in range(1, digit_base):
+            entries.append(chains[j][d])  # idx = d·base (∞ + d·P_j)
+            entries.extend([None] * (base - 1))  # filled from C below
+        A = _stack_points(
+            [prev[i] for d in range(1, digit_base) for i in range(1, base)]
+        )
+        B = _stack_points(
+            [chains[j][d] for d in range(1, digit_base) for i in range(1, base)]
+        )
+        C = jac_add(F, A, B, complete=True)
+        lane = 0
+        for d in range(1, digit_base):
+            for i in range(1, base):
+                entries[d * base + i] = _index_point(C, lane)
+                lane += 1
+    return entries
+
+
+def _gather_entry(F, entries, onehot, base_inf, zero_window):
+    """Select per-lane table rows: one-hot (…, 16) × the stacked
+    coordinate planes, contracted with ONE exact (HIGHEST-precision)
+    matmul over all planes — the MXU form of a lane-varying gather
+    (SURVEY.md §7: no dynamic indexing in the batched graphs).
+
+    The infinity flag needs no gather: for an order-r base point every
+    nonzero-window table entry is finite (its multiplier is a nonzero
+    small combination — |s·w1 + λ·s'·w2| ≤ 3 + 3λ ≪ r in G1, a
+    non-vanishing ±u^j subset sum in G2), so the selected entry is ∞
+    exactly when the window is zero or the base point itself is ∞."""
+    hp = jax.lax.Precision.HIGHEST
+    planes = []
+    for k in range(3):
+        if isinstance(entries[0][k], tuple):  # Fq2 component pair
+            for i in range(len(entries[0][k])):
+                planes.append(jnp.stack([e[k][i] for e in entries], axis=-2))
+        else:
+            planes.append(jnp.stack([e[k] for e in entries], axis=-2))
+    T = jnp.stack(planes, axis=-3)  # (..., planes, 16, NLIMBS)
+    # match the representation dtype: an f32 one-hot against int32 limb
+    # planes (the legacy HBBFT_TPU_FQ_BITS=11 arm) would promote the
+    # gathered coordinates to f32 and break the scan carry's dtype;
+    # integer one-hot contraction is exact in either dtype
+    sel = jnp.einsum(
+        "...w,...cwl->...cl", onehot.astype(T.dtype), T, precision=hp
+    )
+    comps = [sel[..., c, :] for c in range(len(planes))]
+    if isinstance(entries[0][0], tuple):
+        coords = [tuple(comps[2 * k : 2 * k + 2]) for k in range(3)]
+    else:
+        coords = comps
+    inf = zero_window | base_inf
+    return (coords[0], coords[1], coords[2], inf)
+
+
+def _scalar_mul_joint(F, bits, negs, P):
+    """GLV/GLS joint-table Shamir ladder.
+
+    ``bits``: (..., m, W) MSB-first windows of the m decomposed parts
+    (m=2, W=128 for G1 GLV with 2-bit windows; m=4, W=64 for G2 GLS with
+    1-bit windows); ``negs``: (..., m) per-part sign flags; ``P``:
+    batched Jacobian base points of order r.
+
+    Per step: window-many doublings, then ONE complete add of the
+    gathered table entry — 64 steps either way, 16-entry table either
+    way.  The w=0 entry is the explicit infinity lane, so the add is
+    unconditional (no discard-select): zero windows pass through via the
+    inf2 route of jac_add.  Every accumulator add is complete=True — the
+    decomposed prefixes are λ/u-sized, so acc = ±T collisions are
+    adversarially reachable (the module docstring's safety note; the
+    degenerate-case tests drive them on purpose)."""
+    m = int(bits.shape[-2])
+    digit_base, wbits = (4, 2) if m == 2 else (2, 1)
+    parts = []
+    Pj = P
+    for j in range(m):
+        if j:
+            Pj = F.endo(Pj)
+        parts.append(jac_select(F, negs[..., j], jac_neg(F, Pj), Pj))
+    entries = _joint_table(F, parts, digit_base)
+
+    if wbits == 2:
+        w = 2 * bits[..., 0::2] + bits[..., 1::2]  # (..., m, W/2)
+    else:
+        w = bits
+    nent = digit_base**m
+    idx = jnp.zeros(w.shape[:-2] + w.shape[-1:], dtype=w.dtype)
+    for j in range(m):
+        idx = idx + w[..., j, :] * (digit_base**j)
+    xs = jnp.moveaxis(idx, -1, 0)  # (steps, ...)
+
+    acc = infinity_like(F, P)
+
+    base_inf = P[3]
+
+    def step(acc, ix):
+        for _ in range(wbits):
+            acc = jac_double(F, acc)
+        onehot = (
+            ix[..., None] == jnp.arange(nent, dtype=ix.dtype)
+        ).astype(jnp.float32)
+        T = _gather_entry(F, entries, onehot, base_inf, ix == 0)
+        return jac_add(F, acc, T, complete=True), None
+
+    acc, _ = jax.lax.scan(step, acc, xs)
+    return acc
+
+
+# ---------------------------------------------------------------------------
 # Host-side scalar preparation
 # ---------------------------------------------------------------------------
 
@@ -240,12 +587,22 @@ def _scalar_mul_w2(F, bits: jnp.ndarray, P):
 def safe_scalar(s: int) -> Tuple[int, bool]:
     """Return (s', negate) with s ≡ ±s' (mod r) and s' < 2^254.
 
-    Why that bound makes the ladder safe: a selected add step computes
-    acc + P with acc = 2m·P, where the pre-step prefix m has ≤ 253 bits.
-    The unequal-add degenerate case needs 2m ≡ ±1 (mod r); but
-    2m < 2^254 < r − 1, so 2m can be neither 1 (it's even and > 0 when it
-    matters) nor r − 1.  Since r > 2^254.8, at least one of s, r − s is
-    always < 2^254.
+    SCOPE OF THE PROOF BELOW: it covers exactly the two CLASSIC ladder
+    variants — the binary ladder (`scalar_mul`'s scan form) and the 2-bit
+    windowed ladder (`_scalar_mul_w2`) — both of which use the UNEQUAL
+    Jacobian add.  It does NOT cover the GLV/GLS joint-table ladders
+    (`_scalar_mul_joint`): those take decomposed scalars that never pass
+    through safe_scalar, their prefix bound is λ/u-sized rather than
+    2^254, and their accumulator adds are select-routed COMPLETE adds
+    precisely because no analogous structural exclusion exists (see
+    jac_add's exhaustive case split).
+
+    Why the bound makes the classic ladders safe: a selected add step
+    computes acc + P with acc = 2m·P, where the pre-step prefix m has
+    ≤ 253 bits.  The unequal-add degenerate case needs 2m ≡ ±1 (mod r);
+    but 2m < 2^254 < r − 1, so 2m can be neither 1 (it's even and > 0
+    when it matters) nor r − 1.  Since r > 2^254.8, at least one of s,
+    r − s is always < 2^254.
     """
     s %= R
     if not (s >> SCALAR_BITS):
@@ -273,6 +630,177 @@ def scalars_to_bits(scalars: Sequence[int], width: int = SCALAR_BITS) -> np.ndar
     )
     bits = np.unpackbits(buf, axis=1)[:, 8 * nbytes - width :]
     return bits.astype(np.int32)
+
+
+# ---------------------------------------------------------------------------
+# GLV/GLS endomorphism ladders: host-side decomposition (exact-fraction
+# Babai rounding; all Python ints, vectorization-free by necessity).
+# ---------------------------------------------------------------------------
+
+_G1_LAM = _gold._G1_LAMBDA  # λ = x²−1; λ² + λ + 1 == r EXACTLY for BLS12
+assert _G1_LAM * _G1_LAM + _G1_LAM + 1 == R, "GLV basis determinant is not -r"
+_G2_U = _gold._U  # the signed BLS parameter u (ψ eigenvalue on G2)
+assert _G2_U**4 - _G2_U**2 + 1 == R, "GLS basis relation r(u) broken"
+
+#: GLS lattice basis (rows): integer vectors v with Σ v_j·u^j ≡ 0 (mod r).
+_G2_BASIS = (
+    (_G2_U, -1, 0, 0),
+    (0, _G2_U, -1, 0),
+    (0, 0, _G2_U, -1),
+    (1, 0, -1, _G2_U),
+)
+
+
+def _minor3(m, i, j) -> int:
+    mm = [[m[r][c] for c in range(4) if c != j] for r in range(4) if r != i]
+    a, b, c = mm[0]
+    d, e, f = mm[1]
+    g, h, k = mm[2]
+    return a * (e * k - f * h) - b * (d * k - f * g) + c * (d * h - e * g)
+
+
+def _det4(m) -> int:
+    return sum(
+        (-1) ** j * m[0][j] * _minor3(m, 0, j) for j in range(4)
+    )
+
+
+_G2_DET = _det4(_G2_BASIS)
+#: first adjugate row: the Babai coefficients for target (k, 0, 0, 0) are
+#: c_j = k·adj[0][j] / det (row-vector convention c·B = t).
+_G2_ADJ0 = tuple((-1) ** j * _minor3(_G2_BASIS, j, 0) for j in range(4))
+if _G2_DET < 0:
+    _G2_DET = -_G2_DET
+    _G2_ADJ0 = tuple(-a for a in _G2_ADJ0)
+assert _G2_DET == R, "GLS basis determinant is not ±r"
+
+
+def _divround(n: int, d: int) -> int:
+    """round(n/d) to nearest for d > 0 (exact-fraction Babai rounding)."""
+    return (2 * n + d) // (2 * d)
+
+
+def glv_enabled() -> bool:
+    """GLV/GLS decomposition active?  Read per batch (not at import) so
+    ``HBBFT_TPU_NO_GLV=1`` flips in-process A/Bs immediately; the legacy
+    ``HBBFT_TPU_LADDER_BINARY`` knob also forces the classic path (it
+    selects the binary ladder, which GLV would bypass entirely)."""
+    if os.environ.get("HBBFT_TPU_NO_GLV") or os.environ.get(
+        "HBBFT_TPU_LADDER_BINARY"
+    ):
+        return False
+    return _BETA_ROW is not None and _PSI_CX is not None
+
+
+def glv_decompose_g1(k: int) -> List[Tuple[int, bool]]:
+    """k ≡ ±k1 ± λ·k2 (mod r) with |k1|,|k2| ≤ 2^127.
+
+    Exact-fraction Babai on the basis (−λ, 1), (1, λ+1): the rational
+    coordinates of (k, 0) are c1 = −(λ+1)k/r, c2 = k/r (det = −r), each
+    rounded to the nearest integer with pure-int arithmetic.  Returns
+    [(|k1|, k1<0), (|k2|, k2<0)].  The 2^127 bound is property-tested
+    over ≥50k scalars (tests/test_curve_jax.py)."""
+    k %= R
+    b1 = _divround(-(_G1_LAM + 1) * k, R)
+    b2 = _divround(k, R)
+    k1 = k + b1 * _G1_LAM - b2
+    k2 = -b1 - b2 * (_G1_LAM + 1)
+    return [(abs(k1), k1 < 0), (abs(k2), k2 < 0)]
+
+
+def gls_decompose_g2(k: int) -> List[Tuple[int, bool]]:
+    """k ≡ Σ_j ±k_j·u^j (mod r) with |k_j| < 2^63 (4-way GLS split).
+
+    Exact-fraction Babai on `_G2_BASIS` via the precomputed adjugate
+    row / determinant (det = r)."""
+    k %= R
+    bs = [_divround(k * a, _G2_DET) for a in _G2_ADJ0]
+    ks = [
+        (k if j == 0 else 0)
+        - sum(bs[i] * _G2_BASIS[i][j] for i in range(4))
+        for j in range(4)
+    ]
+    return [(abs(x), x < 0) for x in ks]
+
+
+def prep_g1_scalars(scalars: Sequence[int]) -> Tuple[np.ndarray, np.ndarray]:
+    """Full-width G1 ladder prep → (bits, negs) for g1_scalar_mul_signed.
+
+    GLV on (default): bits (B, 2, GLV_HALF_BITS) MSB-first windows of the
+    decomposed halves, negs (B, 2) per-half signs.  GLV off: the classic
+    safe_scalar form — bits (B, SCALAR_BITS), negs (B,).  The device
+    dispatches on the extra axis, so the two forms can never alias."""
+    if not glv_enabled():
+        safe = [safe_scalar(s) for s in scalars]
+        return (
+            scalars_to_bits([s for s, _ in safe]),
+            np.array([n for _, n in safe], dtype=bool),
+        )
+    parts = [glv_decompose_g1(s) for s in scalars]
+    flat = [p for pair in parts for p, _ in pair]
+    bits = scalars_to_bits(flat, GLV_HALF_BITS).reshape(
+        len(scalars), 2, GLV_HALF_BITS
+    )
+    negs = np.array(
+        [n for pair in parts for _, n in pair], dtype=bool
+    ).reshape(len(scalars), 2)
+    return bits, negs
+
+
+def prep_g2_scalars(scalars: Sequence[int]) -> Tuple[np.ndarray, np.ndarray]:
+    """Full-width G2 ladder prep: 4-way GLS form (B, 4, GLS_QUARTER_BITS)
+    when enabled, else the classic safe_scalar form (prep_g1_scalars
+    note)."""
+    if not glv_enabled():
+        safe = [safe_scalar(s) for s in scalars]
+        return (
+            scalars_to_bits([s for s, _ in safe]),
+            np.array([n for _, n in safe], dtype=bool),
+        )
+    parts = [gls_decompose_g2(s) for s in scalars]
+    flat = [p for quad in parts for p, _ in quad]
+    bits = scalars_to_bits(flat, GLS_QUARTER_BITS).reshape(
+        len(scalars), 4, GLS_QUARTER_BITS
+    )
+    negs = np.array(
+        [n for quad in parts for _, n in quad], dtype=bool
+    ).reshape(len(scalars), 4)
+    return bits, negs
+
+
+# Analytic field-mul accounting for the `ladder_field_muls` counter
+# (stacked-mul counts of the formulas above; selects/zero-tests excluded).
+_DBL_MULS = 7  # jac_double: 3 + 3 + 1 stacked products
+_ADD_MULS = 16  # jac_add unequal core: 5 + 4 + 2 + 3 + 2
+_CADD_MULS = _ADD_MULS + _DBL_MULS  # complete add evaluates both routes
+
+
+def ladder_scan_field_muls(bits: np.ndarray, glv: bool) -> int:
+    """Per-lane field-mul count of the MAIN LADDER SCAN a prepared bit
+    matrix drives (table build excluded — see glv_table_field_muls).
+    Fq muls for G1 shapes, Fq2 muls for G2 shapes.
+
+    GLV G1 (m=2, 2-bit windows): 64·(2·7 + 23) = 2368 — vs the w2
+    baseline 127·(2·7 + 16) = 3810, the ~1.6× PERF.md predicted."""
+    width = int(bits.shape[-1])
+    if glv:
+        m = int(bits.shape[-2])
+        wbits = 2 if m == 2 else 1
+        return (width // wbits) * (wbits * _DBL_MULS + _CADD_MULS)
+    if width % 2 == 0 and not os.environ.get("HBBFT_TPU_LADDER_BINARY"):
+        return (width // 2) * (2 * _DBL_MULS + _ADD_MULS)
+    return width * (_DBL_MULS + _ADD_MULS)
+
+
+def glv_table_field_muls(bits: np.ndarray) -> int:
+    """Per-lane field-mul count of the joint-table build (endomorphism
+    applications + 2 doublings + 11 complete adds for m=2; ψ chains +
+    11 complete adds for m=4)."""
+    m = int(bits.shape[-2])
+    if m == 2:
+        return 1 + 2 * _DBL_MULS + 11 * _CADD_MULS  # φ is one constant mul
+    # ψ chained three times, 2 constant Fq2 muls per application
+    return 3 * 2 + 11 * _CADD_MULS
 
 
 # ---------------------------------------------------------------------------
@@ -492,22 +1020,38 @@ def jac_to_affine_g2(P):
     return (x, y, inf)
 
 
+def _scalar_mul_signed(F, points, bits, negs):
+    """Shared signed-ladder dispatch: a bit matrix with a decomposition
+    axis (ndim == point-batch ndim + 2, the prep_g*_scalars GLV/GLS form)
+    routes to the joint-table ladder with per-part signs; the classic
+    form applies the single safe_scalar negation after the w2/binary
+    ladder.  The shapes cannot alias, so the jit cache keys the path."""
+    bits = jnp.asarray(bits)
+    negs = jnp.asarray(negs)
+    if bits.ndim == jnp.ndim(points[3]) + 2:
+        return _scalar_mul_joint(F, bits, negs, points)
+    prods = scalar_mul(F, bits, points)
+    return jac_select(F, negs, jac_neg(F, prods), prods)
+
+
 def g1_scalar_mul_signed(points, bits, negs):
     """Batched ±(bits_i · P_i) ladders: the shared signed-ladder prologue
-    (`negs` is the (B,) bool safe_scalar negation mask)."""
-    prods = g1_scalar_mul_batch(points, bits)
-    return jac_select(_F1, jnp.asarray(negs), jac_neg(_F1, prods), prods)
+    (`negs` is the (B,) safe_scalar negation mask in classic form, or the
+    (B, 2) per-half sign mask in GLV form)."""
+    return _scalar_mul_signed(_F1, points, bits, negs)
 
 
 def g2_scalar_mul_signed(points, bits, negs):
-    prods = g2_scalar_mul_batch(points, bits)
-    return jac_select(_F2, jnp.asarray(negs), jac_neg(_F2, prods), prods)
+    return _scalar_mul_signed(_F2, points, bits, negs)
 
 
 def linear_combine_g1(points, bits, negs):
     """Σ ±(bits_i · P_i) over the leading axis → single Jacobian point.
 
-    `negs` is a (B,) bool array applying the safe_scalar negation.
+    `bits`/`negs` take either prepared form (see prep_g1_scalars): the
+    classic (B, SCALAR_BITS) rows with a (B,) safe_scalar negation mask,
+    or the GLV (B, 2, GLV_HALF_BITS) windows with (B, 2) per-half signs
+    (per-quarter (B, 4, ·) for the G2 twin).
     """
     prods = g1_scalar_mul_signed(points, bits, negs)
     return _tree_sum(_F1, prods, jnp.shape(bits)[0])

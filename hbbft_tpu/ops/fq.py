@@ -64,6 +64,7 @@ pow_fixed = _impl.pow_fixed
 inv = _impl.inv
 batch_inv = _impl.batch_inv
 is_zero_host = _impl.is_zero_host
+is_zero = _impl.is_zero
 
 # Limb-only width diagnostics some tools print (tools/kernel_bench.py):
 # stable limb values regardless of the facade choice, as before the split.

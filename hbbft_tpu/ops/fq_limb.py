@@ -380,3 +380,126 @@ def batch_inv(x: jnp.ndarray) -> jnp.ndarray:
 def is_zero_host(limbs) -> bool:
     """Host-side exact zero test (the only canonical compare we ever need)."""
     return to_int(limbs) == 0
+
+
+# -- in-graph zero test (complete-add route selector; ops/curve.py) ----------
+#
+# The lazy representation has no canonical form, so v ≡ 0 (mod Q) cannot be
+# a limb compare.  The test reconstructs exactness from two ingredients:
+#
+# 1. A float32 estimate of the quotient m ≈ v/Q (weights 2^(BITS·i)/Q).
+#    After ``reduce_small`` the value satisfies |v| < 2·(BASE+2)·Q (the
+#    fold rows are < Q and post-carry3 only the two limbs ≥ FOLD_FROM,
+#    each ≤ BASE+1, contribute a fold row; the un-folded part is
+#    < 2^(BITS·FOLD_FROM) < 8·Q), so the candidate quotient lies in
+#    m_est + c, c ∈ {−2..2}: the f32 estimate error on exact multiples is
+#    ≪ 1 (post-reduce limbs are ≤ BASE+1, so every term is ≤ (BASE+1)·w_i
+#    and the partial sums stay ≤ |m| + 1) — ±2 is generous margin.
+# 2. Exact residues of v modulo ``_NZ_NPRIMES`` probe primes < 1300
+#    (product ≈ 2^407): y_j = Σ_i l_i·(2^(BITS·i) mod p_j) as one
+#    constant matmul whose accumulation provably stays exact (bound
+#    asserted below).  Then v ≡ 0 (mod Q) iff v = m·Q for some candidate
+#    m, iff y_j ≡ m·(Q mod p_j) (mod p_j) for EVERY probe prime — sound
+#    because |v − m·Q| < 2·(BASE+2+2)·Q < 2^394 < Π p_j, so all-residues
+#    -zero forces v − m·Q = 0 exactly.
+#
+# Soundness does not depend on the estimate accuracy (a wrong m simply
+# fails the residue check); completeness (never missing a true zero) is
+# the ±2 candidate window, exercised by the adversarial degenerate-case
+# tests (tests/test_glv_degenerate.py).
+
+
+def _probe_primes(limit: int, count: int) -> List[int]:
+    out: List[int] = []
+    x = limit
+    while len(out) < count and x > 2:
+        x -= 1
+        if all(x % d for d in range(2, int(x**0.5) + 1)):
+            out.append(x)
+    return out
+
+
+_NZ_NPRIMES = 40
+_NZ_P = np.array(_probe_primes(1300, _NZ_NPRIMES), dtype=np.int64)
+# residue weight matrix (NLIMBS, 40): w[i, j] = 2^(BITS·i) mod p_j
+_NZ_W = np.array(
+    [[pow(1 << BITS, i, int(p)) for p in _NZ_P] for i in range(NLIMBS)],
+    dtype=np.float64,
+)
+_NZ_QMOD = np.array([Q % int(p) for p in _NZ_P], dtype=np.float64)
+# quotient-estimate weights 2^(BITS·i)/Q (≤ ~2^11 for the top limb)
+_NZ_EST = np.array(
+    [float(1 << (BITS * i)) / float(Q) for i in range(NLIMBS)], dtype=np.float64
+)
+# accumulation-exactness envelope for the residue matmul: post-carry3
+# limbs are ≤ BASE+1 and weights < max probe prime.  The 8-bit/f32 arm
+# accumulates in f32 (50·257·1296 < 2^24, only just); the 11-bit arm's
+# sums exceed 2^24 and MUST accumulate in int32 (< 2^31 with margin) —
+# an f32 accumulation there silently rounds residues and turns the zero
+# test into a coin flip (caught by the int32 degenerate-route test arm).
+if DTYPE == jnp.float32:
+    assert NLIMBS * (BASE + 1) * (int(_NZ_P[0]) - 1) < (1 << 24), (
+        "residue-probe matmul would exceed the f32-exact envelope"
+    )
+else:
+    assert NLIMBS * (BASE + 1) * (int(_NZ_P[0]) - 1) < (1 << 31), (
+        "residue-probe matmul would exceed int32"
+    )
+
+
+def is_zero(x: jnp.ndarray) -> jnp.ndarray:
+    """Exact in-graph test: does the limb vector represent 0 mod Q?
+
+    Returns a bool array over the batch shape.  Accepts any lazy input
+    within the representation's domain (a difference/sum of a few mul
+    outputs included) — ``reduce_small`` renormalizes first.  Cost: two
+    constant matmuls + a handful of vector passes, ≪ one field mul.
+    """
+    x = reduce_small(jnp.asarray(x, DTYPE))
+    hp = jax.lax.Precision.HIGHEST
+    # quotient estimate: post-reduce limbs are ≤ BASE+1 ≤ 2049 — exact in
+    # f32 — and the weighted sum is ~|v|/Q ≲ 2^12, so f32 is plenty
+    t = jnp.einsum(
+        "...i,i->...",
+        x.astype(jnp.float32),
+        jnp.asarray(_NZ_EST, jnp.float32),
+        precision=hp,
+    )
+    m0 = jnp.round(t)
+    hit = jnp.zeros(x.shape[:-1], dtype=bool)
+    if DTYPE == jnp.int32:
+        y = jnp.mod(
+            jnp.einsum("...i,ij->...j", x, jnp.asarray(_NZ_W, jnp.int32)),
+            jnp.asarray(_NZ_P, jnp.int32),
+        )
+        qmod = jnp.asarray(_NZ_QMOD, jnp.int32)
+        p_i = jnp.asarray(_NZ_P, jnp.int32)
+        m0_i = m0.astype(jnp.int32)
+        for c in (-2, -1, 0, 1, 2):
+            # (m0+c)·qmod ≤ ~2^13·1300 < 2^24 — int32-exact
+            r = y - jnp.mod((m0_i + c)[..., None] * qmod, p_i)
+            hit = hit | jnp.all(jnp.mod(r, p_i) == 0, axis=-1)
+        return hit
+    y = jnp.einsum(
+        "...i,ij->...j",
+        x,
+        jnp.asarray(_NZ_W, jnp.float32),
+        precision=hp,
+    )
+    p = jnp.asarray(_NZ_P, jnp.float32)
+    invp = jnp.asarray(1.0 / _NZ_P, jnp.float32)
+    qmod = jnp.asarray(_NZ_QMOD, jnp.float32)
+
+    def modp(v):
+        # exact for integer-valued f32 |v| < 2^24: one estimated-quotient
+        # pass, then two branchless clamps (floor may be off by one)
+        v = v - jnp.floor(v * invp) * p
+        v = v - p * (v >= p)
+        return v + p * (v < 0)
+
+    y = modp(y)
+    for c in (-2, -1, 0, 1, 2):
+        # (m0+c)·qmod ≤ ~600·1300 < 2^20 — f32-exact before the mod
+        r = y - modp((m0 + c)[..., None] * qmod)
+        hit = hit | jnp.all(r == 0, axis=-1)
+    return hit

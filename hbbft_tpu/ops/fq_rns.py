@@ -588,3 +588,45 @@ def batch_inv(x: jnp.ndarray) -> jnp.ndarray:
 
 def is_zero_host(res) -> bool:
     return to_int(res) == 0
+
+
+# -- in-graph zero test (complete-add route selector; ops/curve.py) ----------
+#
+# An RNS vector determines its integer value v exactly mod M1 (base B1
+# alone), and every value the curve layer feeds here is a short linear
+# combination of Montgomery-mul outputs — each in [0, 41·Q) — so
+# |v| < _Z_BOUND·Q ≪ M1/2.  Testing Q | v then needs no CRT readback:
+# let v' = v + _Z_BOUND·Q (non-negative) and w ≡ v'·Q⁻¹ (mod p_i) per
+# lane.  If Q | v then v' = (m + _Z_BOUND)·Q with 0 ≤ m + _Z_BOUND <
+# 2·_Z_BOUND, so w equals that SAME small integer on every lane (it is
+# < every base prime).  Conversely, if all 39 lanes agree on a value
+# c < 2·_Z_BOUND, then w ≡ c mod M1, hence v' ≡ c·Q (mod M1); both
+# sides lie in [0, 2·_Z_BOUND·Q) ⊂ [0, M1), so v' = c·Q exactly and
+# Q | v.  Cost: two pointwise mul+mod passes over 39 lanes.
+
+#: |value| bound the zero test accepts: covers any ± combination of a few
+#: fq2 Karatsuba recombinations of mul outputs (each component of an fq2
+#: product is within (−2·41·Q, 41·Q); a difference of two stays well
+#: inside 256·Q).
+_Z_BOUND = 256
+assert 2 * _Z_BOUND * Q < M1, "zero-test bound must stay CRT-unambiguous"
+assert 2 * _Z_BOUND < min(B1), "zero-test digit must fit every base prime"
+_Z_OFF_B1 = np.array([(_Z_BOUND * Q) % int(p) for p in B1], dtype=NP_DTYPE)
+_Z_QINV_B1 = np.array([pow(Q, -1, int(p)) for p in B1], dtype=NP_DTYPE)
+
+
+def is_zero(x: jnp.ndarray) -> jnp.ndarray:
+    """Exact in-graph test: does the residue vector represent 0 mod Q?
+
+    Returns a bool array over the batch shape.  Contract: the represented
+    INTEGER value (not just the class mod Q) must satisfy |v| <
+    ``_Z_BOUND``·Q — true for any difference/sum of a few mul outputs;
+    raw canonical inputs ([0, Q)) trivially qualify.  Lanes may be lazy.
+    """
+    p1 = _P_J[_S1]
+    ip1 = _INVP_J[_S1]
+    v = _mod_lanes(jnp.asarray(x, DTYPE)[..., _S1], p1, ip1)
+    v = _mod_lanes(v + jnp.asarray(_Z_OFF_B1), p1, ip1)
+    w = _mod_lanes(v * jnp.asarray(_Z_QINV_B1), p1, ip1)
+    same = jnp.all(w == w[..., :1], axis=-1)
+    return same & (w[..., 0] < 2 * _Z_BOUND)

@@ -61,6 +61,14 @@ def fq2_conj(a):
     return (a[0], fq.neg(a[1]))
 
 
+def fq2_is_zero(a):
+    """In-graph exact zero test (bool over the batch shape).  Inherits
+    fq.is_zero's value-domain contract on BOTH components — satisfied by
+    any ± combination of a few fq2 products (the Karatsuba recombination
+    keeps each component within a handful of mul outputs)."""
+    return fq.is_zero(a[0]) & fq.is_zero(a[1])
+
+
 def fq2_mul_pairs(a, b) -> list:
     """The 3 Karatsuba Fq operand pairs of an fq2 product (for stacking)."""
     return [

@@ -65,6 +65,20 @@ class Counters:
     # from / inserted into the limb-row cache per staging call
     stage_cache_hits: int = 0
     stage_cache_misses: int = 0
+    # GLV/GLS endomorphism-ladder accounting (ops/curve.py): analytic
+    # field-mul counts of the dispatched scalar-ladder scans (per-lane
+    # formula cost × lanes; Fq muls on G1 shapes, Fq2 muls on G2 shapes —
+    # the unit mirrors what the graph actually executes per lane), the
+    # separate joint-table build cost, the number of host Babai
+    # decompositions, and the host wall spent decomposing + packing
+    # windows (the host share of the table path; the in-graph table
+    # build itself is device time).  ladder_field_muls is the
+    # glv_ladder_ab bench row's numerator: the ≥1.5× per-G1-ladder
+    # reduction (2368 vs 3810) reads directly off it.
+    ladder_field_muls: int = 0
+    glv_table_field_muls: int = 0
+    glv_decompositions: int = 0
+    glv_table_build_seconds: float = 0.0
     # device_seconds split by dispatch kind (round-4 verdict task 7: the
     # n16 on-chip epoch was 90% unattributed).  Sums to device_seconds up
     # to the rare unkinded dispatch; zero-valued kinds are elided from
@@ -77,6 +91,7 @@ class Counters:
     device_seconds_decrypt: float = 0.0  # batched G1 decrypt-share ladders
     device_seconds_dkg: float = 0.0  # batched era-change DKG ladders/MSMs
     device_seconds_encrypt: float = 0.0  # batched threshold-encrypt ladders
+    device_seconds_glv_ab: float = 0.0  # glv_ladder_ab bench-row dispatches
 
     def snapshot(self) -> Dict[str, float]:
         return asdict(self)

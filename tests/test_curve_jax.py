@@ -146,6 +146,83 @@ def test_linear_combine_g2(rng):
     assert got == want
 
 
+def test_glv_gls_decomposition_properties():
+    """≥50k random scalars per group: the Babai decompositions respect
+    their magnitude bounds and reconstruct k exactly.
+
+    G1: k ≡ ±k1 ± λ·k2 (mod r) with |k1|,|k2| ≤ 2^127 (the bound the
+    GLV_HALF_BITS=128 window packing relies on).
+    G2: k ≡ Σ ±k_j·u^j (mod r) with |k_j| < 2^63 (GLS_QUARTER_BITS=64).
+    Edge scalars (0, 1, r−1, λ, r−λ, u mod r, crafted degenerate forms)
+    ride along with the random sample."""
+    rng = random.Random(31)
+    lam = curve._G1_LAM
+    mu = curve._G2_U % R
+    edges = [0, 1, 2, R - 1, R - 2, lam, R - lam, mu, R - mu,
+             (5 + 5 * lam) % R, (R - 7 - lam * (lam + 1)) % R]
+    for k in edges + [rng.randrange(R) for _ in range(50_000)]:
+        (a, na), (b, nb) = curve.glv_decompose_g1(k)
+        assert a <= 1 << 127 and b <= 1 << 127
+        sa = -a if na else a
+        sb = -b if nb else b
+        assert (sa + lam * sb - k) % R == 0
+        quads = curve.gls_decompose_g2(k)
+        assert all(q < 1 << 63 for q, _ in quads)
+        total = sum(
+            (-q if n else q) * pow(mu, j, R) for j, (q, n) in enumerate(quads)
+        )
+        assert (total - k) % R == 0
+
+
+def test_g1_glv_ladder_matches_host(rng):
+    """Joint-table GLV ladder vs the golden reference at the group level,
+    including the λ-sized and zero edge scalars.  Jitted: one compiled
+    graph instead of minutes of eager op dispatch on XLA:CPU."""
+    import jax
+
+    ks = [rng.randrange(R), curve._G1_LAM, 0]
+    pts = [rnd_g1(rng) for _ in range(len(ks))]
+    bits, negs = curve.prep_g1_scalars(ks)
+    assert bits.shape == (len(ks), 2, curve.GLV_HALF_BITS)
+    got = curve.g1_from_device(
+        jax.jit(curve.g1_scalar_mul_signed)(curve.g1_to_device(pts), bits, negs)
+    )
+    want = [gold.ec_mul(gold.FQ, k, p) if k % R else None for k, p in zip(ks, pts)]
+    assert got == want
+
+
+def test_g2_gls_ladder_matches_host(rng):
+    import jax
+
+    ks = [rng.randrange(R), (curve._G2_U) % R]
+    pts = [rnd_g2(rng) for _ in range(len(ks))]
+    bits, negs = curve.prep_g2_scalars(ks)
+    assert bits.shape == (len(ks), 4, curve.GLS_QUARTER_BITS)
+    got = curve.g2_from_device(
+        jax.jit(curve.g2_scalar_mul_signed)(curve.g2_to_device(pts), bits, negs)
+    )
+    want = [gold.ec_mul(gold.FQ2, k, p) for k, p in zip(ks, pts)]
+    assert got == want
+
+
+def test_ladder_field_mul_accounting():
+    """The analytic per-lane costs behind the ladder_field_muls counter:
+    the GLV G1 scan is the predicted 2368 vs the w2 baseline 3810 (the
+    ≥1.5× acceptance number), GLS G2 is 1920, and the RLC-width w2 form
+    scales with width."""
+    g1_bits, _ = curve.prep_g1_scalars([5])
+    g2_bits, _ = curve.prep_g2_scalars([5])
+    assert curve.ladder_scan_field_muls(g1_bits, True) == 2368
+    assert curve.ladder_scan_field_muls(g2_bits, True) == 1920
+    w2 = np.zeros((1, curve.SCALAR_BITS), dtype=np.int32)
+    assert curve.ladder_scan_field_muls(w2, False) == 3810
+    rlc = np.zeros((1, 4, 64), dtype=np.int32)
+    assert curve.ladder_scan_field_muls(rlc, False) == 32 * 30
+    assert 3810 / 2368 > 1.6
+    assert curve.glv_table_field_muls(g1_bits) > 0
+    assert curve.glv_table_field_muls(g2_bits) > 0
+
+
 def test_windowed_and_binary_ladders_agree(monkeypatch):
     """The 2-bit windowed ladder (default for even widths) and the binary
     scan form (HBBFT_TPU_LADDER_BINARY=1) must produce identical points;

@@ -89,3 +89,11 @@ def test_public_batch_entry_points_have_kinded_defaults():
         fn = getattr(backend_mod.TpuBackend, fn_name)
         default = inspect.signature(fn).parameters["kind"].default
         assert default in valid, (fn_name, default)
+
+
+def test_glv_ab_bench_kind_registered():
+    """bench.py's glv_ladder_ab row dispatches its A/B ladders under
+    kind="glv_ab" (through g1_mul_batch) so the row's device time is
+    attributable separately from real DKG work — the kind must exist as
+    a Counters field or the dispatch would be unkinded."""
+    assert "glv_ab" in _counters_kinds()

@@ -1,0 +1,157 @@
+"""Adversarial degenerate-case tests for the GLV/GLS joint-table ladders.
+
+The classic ladders exclude the unequal-add degenerate case structurally
+(safe_scalar's proof); the joint-table ladders cannot — the short lattice
+vectors put decomposed coordinates inside the prefix ranges, so crafted
+scalars reach acc = ±T mid-ladder.  These tests drive exactly those
+collisions and assert the select-routed complete add returns the correct
+point (an incomplete add would produce finite-residue garbage and a wrong
+group element, so correctness here is a sharp probe of the route).
+
+Constructions (verified arithmetically in-test before the ladder runs):
+
+* G1 doubling route: halves (k1, k2) = (7, λ+1).  At the final window
+  step the accumulator multiplier is 4·(1 + λ·(λ+1)/4) = 4 + λ(λ+1) =
+  r + 3 ≡ 3, and the selected table entry is w1 = 3 — acc == T, the
+  P = Q case.  (λ+1 ≡ 0 mod 4 for BLS12-381, so (λ+1)/4 is an integer
+  prefix; λ+1 exceeds the 2^127 Babai bound, which is WHY an adversary
+  must hand-craft the halves — and why the ladder must not trust bounds.)
+* G1 infinity route: halves (1, λ+1) → final-step accumulator ≡ −1 with
+  table entry w1 = 1 — acc == −T, the P = −Q case; the whole product is
+  r·P = ∞, so the ladder must output the point at infinity.
+* G2 doubling route: quarters (3, 0, 3, |u|) with signs (+, +, −, −).
+  The final-step collision 2·M − T = 1 − u² + u·u³ = r holds exactly
+  (asserted in-test); expected product (2 − 2u²)·P.
+
+The non-default ``HBBFT_TPU_FQ_IMPL`` arm runs the same module in a
+subprocess (the impl binds at import) — both field implementations must
+route the degenerate cases identically.
+"""
+
+import os
+import random
+import subprocess
+import sys
+
+import pytest
+
+from hbbft_tpu.crypto import bls381 as gold
+from hbbft_tpu.crypto.field import R
+from hbbft_tpu.ops import curve, fq
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+pytestmark = pytest.mark.skipif(
+    not curve.glv_enabled(), reason="GLV disabled in this environment"
+)
+
+
+def _ladder_g1(halves, negs, pts):
+    import jax
+    import numpy as np
+
+    bits = curve.scalars_to_bits(
+        [h for pair in halves for h in pair], curve.GLV_HALF_BITS
+    ).reshape(len(halves), 2, curve.GLV_HALF_BITS)
+    negs = np.array(negs, dtype=bool).reshape(len(halves), 2)
+    return curve.g1_from_device(
+        jax.jit(curve.g1_scalar_mul_signed)(curve.g1_to_device(pts), bits, negs)
+    )
+
+
+def test_g1_doubling_and_infinity_routes():
+    lam = curve._G1_LAM
+    assert (lam + 1) % 4 == 0
+    # meta-check the crafted collisions: accumulator vs table multiplier
+    # at the final step, doubling case acc ≡ T, infinity case acc ≡ −T
+    acc_dbl = 4 * (1 + lam * ((lam + 1) // 4)) % R
+    assert acc_dbl == 3 % R  # selected entry w1 = 3
+    acc_inf = 4 * (0 + lam * ((lam + 1) // 4)) % R
+    assert acc_inf == (R - 1) % R  # selected entry w1 = 1 → acc == −T
+
+    rng = random.Random(17)
+    p = gold.ec_mul(gold.FQ, rng.randrange(1, R), gold.G1_GEN)
+    got = _ladder_g1(
+        [(7, lam + 1), (1, lam + 1)],
+        [(False, False), (False, False)],
+        [p, p],
+    )
+    want_dbl = gold.ec_mul(gold.FQ, (7 + lam * (lam + 1)) % R, p)
+    assert (7 + lam * (lam + 1)) % R == 6
+    assert got[0] == want_dbl  # doubling route returned 6·P
+    assert (1 + lam * (lam + 1)) % R == 0
+    assert got[1] is None  # infinity route: r·P = ∞
+
+
+def test_g2_doubling_route():
+    u = curve._G2_U  # signed, negative for BLS12-381
+    au = abs(u)
+    assert au % 2 == 0
+    # final-step collision: 2·M − T = 1 − u² + u·u³ = r exactly
+    assert 1 - u * u + u * (u**3) == R
+    k = (3 - 3 * u * u + u**4) % R
+    assert k == (2 - 2 * u * u) % R
+
+    rng = random.Random(23)
+    p = gold.ec_mul(gold.FQ2, rng.randrange(1, R), gold.G2_GEN)
+    import jax
+    import numpy as np
+
+    quarters = [3, 0, 3, au]
+    bits = curve.scalars_to_bits(quarters, curve.GLS_QUARTER_BITS).reshape(
+        1, 4, curve.GLS_QUARTER_BITS
+    )
+    negs = np.array([[False, False, True, True]])
+    got = curve.g2_from_device(
+        jax.jit(curve.g2_scalar_mul_signed)(curve.g2_to_device([p]), bits, negs)
+    )
+    assert got == [gold.ec_mul(gold.FQ2, k, p)]
+
+
+def _rerun_module(extra_env: dict, tag: str) -> None:
+    env = dict(os.environ)
+    env.update(extra_env)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "pytest",
+            "-x",
+            "-q",
+            "-m",
+            "not slow",
+            os.path.join(_REPO, "tests", "test_glv_degenerate.py"),
+        ],
+        env=env,
+        capture_output=True,
+        text=True,
+        cwd=_REPO,
+        timeout=1800,
+    )
+    assert proc.returncode == 0, (
+        f"degenerate-route tests failed under {tag}:\n"
+        f"{proc.stdout[-3000:]}\n{proc.stderr[-2000:]}"
+    )
+
+
+@pytest.mark.slow
+def test_degenerate_routes_under_other_fq_impl():
+    """Re-run this module's in-process tests under the non-default field
+    implementation (import-time binding → subprocess), so the complete
+    add's zero-test routing is proven on BOTH representations."""
+    other = "limb" if fq.IMPL == "rns" else "rns"
+    _rerun_module({"HBBFT_TPU_FQ_IMPL": other}, other)
+
+
+@pytest.mark.slow
+def test_degenerate_routes_under_int32_limb_width():
+    """The legacy 11-bit int32 limb representation must drive the same
+    routes: the table gather and zero probes run in int32 there, and a
+    dtype promotion anywhere in the joint-table ladder breaks the scan
+    carry at trace time (regression: the one-hot gather einsum used to
+    promote int32 planes to f32)."""
+    _rerun_module(
+        {"HBBFT_TPU_FQ_IMPL": "limb", "HBBFT_TPU_FQ_BITS": "11"},
+        "limb/int32 (BITS=11)",
+    )

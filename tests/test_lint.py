@@ -601,4 +601,83 @@ def test_all_rules_registered():
         "byzantine-input",
         "tracer-safety",
         "deferred-fetch",
+        "glv-table-order",
     }
+
+
+# ---------------------------------------------------------------------------
+# Rule family 6: glv-table-order (determinism family, ops/curve.py)
+# ---------------------------------------------------------------------------
+
+CURVE_PATH = "hbbft_tpu/ops/curve.py"
+
+
+def _glv_rule():
+    from hbbft_tpu.analysis.rules_determinism import GlvTableOrderRule
+
+    return GlvTableOrderRule()
+
+
+def test_glv_table_order_catches_non_range_iteration():
+    findings = lint_sources(
+        _glv_rule(),
+        {
+            CURVE_PATH: """\
+            def _joint_table(F, parts, digit_base):
+                entries = {}
+                for idx in sorted({1, 5, 3}):
+                    entries[idx] = parts[0]
+                extra = [p for p in entries.values()]
+                return entries
+            """
+        },
+    )
+    msgs = [f.message for f in findings]
+    assert len(msgs) == 2  # the for loop and the comprehension
+    assert all("range(" in m for m in msgs)
+
+
+def test_glv_table_order_accepts_range_build_and_requires_presence():
+    clean = lint_sources(
+        _glv_rule(),
+        {
+            CURVE_PATH: """\
+            def _joint_table(F, parts, digit_base):
+                entries = [None]
+                for idx in range(1, digit_base ** len(parts)):
+                    entries.append(parts[0])
+                return entries
+            """
+        },
+    )
+    assert clean == []
+    missing = lint_sources(
+        _glv_rule(),
+        {CURVE_PATH: "def other():\n    return 1\n"},
+    )
+    assert len(missing) == 1
+    assert "no _joint_table" in missing[0].message
+
+
+def test_glv_table_order_suppression():
+    findings = lint_sources(
+        _glv_rule(),
+        {
+            CURVE_PATH: """\
+            def _joint_table(F, parts, digit_base):
+                out = []
+                # lint: allow[glv-table-order] provably fixed tuple order
+                for idx in (1, 2, 3):
+                    out.append(parts[0])
+                return out
+            """
+        },
+    )
+    assert findings == []
+
+
+def test_glv_table_order_real_module_clean():
+    """The real ops/curve.py build satisfies the fixed-order guard."""
+    src = (REPO_ROOT / CURVE_PATH).read_text(encoding="utf-8")
+    findings = lint_sources(_glv_rule(), {CURVE_PATH: src})
+    assert findings == []

@@ -97,6 +97,9 @@ done_matrix_rns_b() {
 done_matrix_limb_b() {
   has_row "$ART/rows_after_matrix_limb_b.json" rlc_dec_verify_throughput fq_impl=limb
 }
+done_glv_ab() {
+  has_row "$ART/rows_after_glv_ab.json" glv_ladder_ab
+}
 done_flips10k() {
   has_row "$ART/rows_after_flips10k.json" coin_flips_per_sec flips=10000
 }
@@ -130,6 +133,14 @@ do_matrix_rns_a()  { HBBFT_TPU_FQ_IMPL=rns  BENCH_ONLY=$MATRIX_ONLY timeout 1800
 do_matrix_limb_a() { HBBFT_TPU_FQ_IMPL=limb BENCH_ONLY=$MATRIX_ONLY timeout 1800 python bench.py; }
 do_matrix_rns_b()  { HBBFT_TPU_FQ_IMPL=rns  BENCH_ONLY=$MATRIX_ONLY timeout 1800 python bench.py; }
 do_matrix_limb_b() { HBBFT_TPU_FQ_IMPL=limb BENCH_ONLY=$MATRIX_ONLY timeout 1800 python bench.py; }
+do_glv_ab() {
+  # GLV joint-table vs w2 ladder A/B (PR 4): ON-CHIP capture of the
+  # 2368-vs-3810 field-mul prediction and the wall-clock delta at a real
+  # dispatch shape.  In-process A/B (HBBFT_TPU_NO_GLV read per batch);
+  # cheap — runs early so no window death can lose it.
+  HBBFT_TPU_FQ_IMPL=rns BENCH_ONLY=glv_ladder BENCH_GLV_BATCH=1024 \
+    timeout 1800 python bench.py
+}
 do_flips10k() {
   HBBFT_TPU_FQ_IMPL=rns BENCH_ONLY=coin_e2e BENCH_COIN_FLIPS=10000 \
     timeout 3600 python bench.py
@@ -218,7 +229,7 @@ do_n100_churn() {
     timeout 18000 python bench.py
 }
 
-STEPS="n100 matrix_rns_a matrix_limb_a matrix_rns_b matrix_limb_b n16_churn flips10k kernel_levers driver_budget rs_ab n32_churn n64coin n100_churn"
+STEPS="n100 matrix_rns_a matrix_limb_a matrix_rns_b matrix_limb_b glv_ab n16_churn flips10k kernel_levers driver_budget rs_ab n32_churn n64coin n100_churn"
 
 for s in $STEPS; do
   if "done_$s"; then
