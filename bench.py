@@ -948,6 +948,8 @@ def _bench_array_engine(
     # would skew the attribution the *_per_epoch fields exclude)
     merged0 = net.counters.merged_with(backend.counters)
     tracer.histograms.clear()
+    from hbbft_tpu.obs.hostbuckets import HOST_BUCKETS
+
     churn_ctr = {
         "device_seconds": 0.0,
         "hash_g2_seconds": 0.0,
@@ -956,6 +958,12 @@ def _bench_array_engine(
         # from steady-state per-epoch fields like churn_time is
         "host_assembly_seconds": 0.0,
         "overlap_seconds": 0.0,
+        # host-bucket attribution (PR 5): total attributable host wall
+        # and its named split, excluded from steady-state per-epoch
+        # fields the same way
+        "host_seconds": 0.0,
+        "fetch_blocked_seconds": 0.0,
+        **{f"host_bucket_{b}": 0.0 for b in HOST_BUCKETS},
         # per-kind split (r4 verdict task 7): rows elide zero-valued kinds
         "device_seconds_pairing": 0.0,
         "device_seconds_rlc_sig": 0.0,
@@ -1025,21 +1033,52 @@ def _bench_array_engine(
         # calls, hash_g2_seconds = host EC hashing — both per
         # steady-state epoch (era-change work excluded, like churn_time).
         delta = counters.diff(ctr0)
+        skip_keys = {
+            "host_assembly_seconds", "overlap_seconds", "host_seconds",
+            "fetch_blocked_seconds",
+        }
         for key in churn_ctr:
-            if key in ("host_assembly_seconds", "overlap_seconds"):
+            if key in skip_keys or key.startswith("host_bucket_"):
                 continue  # emitted below under their canonical names
             val = delta.get(key, 0.0) - churn_ctr[key]
             if val > 0:
                 row[f"{key}_per_epoch"] = round(val / done, 4)
-        # host/device split without a trace attached (PR 3): host-side
-        # staging per epoch, and the fraction of device dispatch wall
-        # during which the host was doing OTHER work (assembly of the
-        # next chunk) instead of blocking on the fetch.  Sync mode
-        # (HBBFT_TPU_NO_PIPELINE=1) reads overlap_fraction == 0.
-        host = delta.get("host_assembly_seconds", 0.0) - churn_ctr[
-            "host_assembly_seconds"
-        ]
+        # host/device split without a trace attached (PR 5):
+        # host_seconds_per_epoch is the TOTAL host wall inside the timed
+        # epochs minus device-fetch-blocked time (the engine's epoch
+        # region, obs/hostbuckets.py — before PR 5 this field carried
+        # only the staging slice), host_buckets is its named exclusive
+        # split, and host_unattributed_fraction is the residual "other"
+        # share the <10% acceptance bar tracks.  overlap_fraction: the
+        # fraction of device dispatch wall during which the host was
+        # doing OTHER work (assembly of the next chunk) instead of
+        # blocking on the fetch; sync mode (HBBFT_TPU_NO_PIPELINE=1)
+        # reads 0.
+        host = delta.get("host_seconds", 0.0) - churn_ctr["host_seconds"]
         row["host_seconds_per_epoch"] = round(max(host, 0.0) / done, 4)
+        blocked = delta.get("fetch_blocked_seconds", 0.0) - churn_ctr[
+            "fetch_blocked_seconds"
+        ]
+        if blocked > 0:
+            row["fetch_blocked_seconds_per_epoch"] = round(blocked / done, 4)
+        buckets = {}
+        for b in HOST_BUCKETS:
+            key = f"host_bucket_{b}"
+            val = delta.get(key, 0.0) - churn_ctr[key]
+            if val > 0:
+                buckets[b] = round(val / done, 4)
+        if buckets:
+            row["host_buckets"] = buckets
+        if host > 0:
+            row["host_unattributed_fraction"] = round(
+                max(
+                    delta.get("host_bucket_other", 0.0)
+                    - churn_ctr["host_bucket_other"],
+                    0.0,
+                )
+                / host,
+                4,
+            )
         dev = delta.get("device_seconds", 0.0) - churn_ctr["device_seconds"]
         ovl = delta.get("overlap_seconds", 0.0) - churn_ctr["overlap_seconds"]
         row["overlap_fraction"] = round(ovl / dev, 4) if dev > 0 else 0.0

@@ -236,13 +236,21 @@ class TracerSafetyRule(Rule):
 class DeferredFetchRule(Rule):
     """The dispatch layer's only host sync point is the deferred-fetch
     seam (ops/pipeline.py ``fetch_to_host``): flag any ``np.asarray``,
-    ``jax.device_get`` or ``.block_until_ready()`` in ops/backend.py or
-    parallel/backend.py — an inline fetch there re-serializes the
-    pipeline (host assembly can no longer overlap device execution) and
-    bypasses the device-seconds/overlap attribution contract."""
+    ``jax.device_get`` or ``.block_until_ready()`` in ops/backend.py,
+    parallel/backend.py, or the engine/ modules — an inline fetch there
+    re-serializes the pipeline (host assembly can no longer overlap
+    device execution) and bypasses the device-seconds/overlap
+    attribution contract.  The engine/ scope (PR 5) guards the
+    round-level assembly seam: the array engine now assembles round
+    r+1's item lists while round r's dispatches execute, and a stray
+    fetch in the engine would silently collapse that overlap too."""
 
     rule_id = "deferred-fetch"
-    scope = ("hbbft_tpu/ops/backend.py", "hbbft_tpu/parallel/backend.py")
+    scope = (
+        "hbbft_tpu/ops/backend.py",
+        "hbbft_tpu/parallel/backend.py",
+        "hbbft_tpu/engine/",
+    )
 
     def check_module(self, mod: ModuleSource) -> List[Finding]:
         findings: List[Finding] = []

@@ -47,6 +47,7 @@ class CryptoBackend(abc.ABC):
 
     def __init__(self, group: Group) -> None:
         self.group = group
+        from hbbft_tpu.obs.hostbuckets import HostBuckets
         from hbbft_tpu.utils.metrics import Counters
 
         #: operative-metric tallies (SURVEY.md §5): shares verified/combined,
@@ -57,6 +58,13 @@ class CryptoBackend(abc.ABC):
         #: (host backends span the batched host call; TpuBackend spans the
         #: actual jitted dispatch+fetch with ``device=True``).
         self.tracer = None
+        #: host-time attribution regions (obs/hostbuckets.py): the array
+        #: engine wraps its epoch phases in ``buckets.region(...)`` blocks
+        #: so ``host_seconds`` splits into named ``host_bucket_*``
+        #: counters; device backends nest their staging blocks under it.
+        self.buckets = HostBuckets(
+            self.counters, tracer_ref=lambda: self.tracer
+        )
 
     def _traced(self, kind: str, n_items: int, fn: Callable[[], Any]) -> Any:
         """Run one batched backend call under a dispatch span when tracing.
@@ -133,6 +141,35 @@ class CryptoBackend(abc.ABC):
         return self._traced(
             "pairing", len(items), lambda: [ct.verify() for ct in items]
         )
+
+    # -- deferred verification (cross-round host pipelining) -----------------
+    #
+    # The array engine overlaps round r+1's item-list assembly with round
+    # r's verification dispatches: each *_deferred entry point SUBMITS the
+    # batch and returns a zero-arg resolver producing the same List[bool]
+    # the synchronous twin returns.  Device backends submit the work
+    # behind the bounded in-flight queue (ops/pipeline.py) and resolve on
+    # call; the defaults here compute eagerly (host backends have nothing
+    # to overlap), so every backend satisfies the contract: identical
+    # results and counter accounting, dispatch counts unchanged.
+
+    def verify_sig_shares_deferred(
+        self, items: Sequence[Tuple[PublicKeyShare, bytes, SignatureShare]]
+    ) -> Callable[[], List[bool]]:
+        out = self.verify_sig_shares(items)
+        return lambda: out
+
+    def verify_dec_shares_deferred(
+        self, items: Sequence[Tuple[PublicKeyShare, Ciphertext, DecryptionShare]]
+    ) -> Callable[[], List[bool]]:
+        out = self.verify_dec_shares(items)
+        return lambda: out
+
+    def verify_ciphertexts_deferred(
+        self, items: Sequence[Ciphertext]
+    ) -> Callable[[], List[bool]]:
+        out = self.verify_ciphertexts(items)
+        return lambda: out
 
     # -- combination ---------------------------------------------------------
 
@@ -298,9 +335,11 @@ class MockBackend(CryptoBackend):
             counters=None, tracer_ref=None, depth_fn=lambda: 1 << 30
         )
 
-    def _piped(self, items: Sequence, compute: Callable[[Sequence], List]) -> List:
-        """Chunked deferred delivery with deterministic out-of-order
-        resolution (chunks resolve last-submitted-first)."""
+    def _piped_submit(self, items: Sequence, compute: Callable[[Sequence], List]):
+        """Submit chunked deferred deliveries; returns (out, finish) where
+        ``finish()`` resolves every pending chunk in a deterministic
+        OUT-OF-ORDER permutation (last-submitted-first) and returns
+        ``out`` fully populated."""
         step = self.pipeline_chunk or len(items) or 1
         out: List[Any] = [None] * len(items)
         for lo in range(0, len(items), step):
@@ -313,8 +352,17 @@ class MockBackend(CryptoBackend):
                 lambda chunk=chunk: compute(chunk), fetch=None,
                 on_result=deliver,
             )
-        self._pipe.flush(order=list(reversed(range(len(self._pipe)))))
-        return out
+
+        def finish():
+            self._pipe.flush(order=list(reversed(range(len(self._pipe)))))
+            return out
+
+        return out, finish
+
+    def _piped(self, items: Sequence, compute: Callable[[Sequence], List]) -> List:
+        """Chunked deferred delivery with deterministic out-of-order
+        resolution (chunks resolve last-submitted-first)."""
+        return self._piped_submit(items, compute)[1]()
 
     def verify_sig_shares(self, items) -> List[bool]:
         # Inlined mock math (e(a,b) = a·b over Z_r): the generic loop costs
@@ -356,6 +404,44 @@ class MockBackend(CryptoBackend):
                 "pairing", len(items), lambda: self._piped(items, compute)
             )
         return self._traced("pairing", len(items), lambda: compute(items))
+
+    def verify_sig_shares_deferred(self, items):
+        """Deferred twin through the simulated-async pipeline when
+        ``pipeline_chunk`` is set, so tier-1 exercises the engine's
+        cross-round overlap seam (submit → assemble elsewhere → resolve
+        out of order) without JAX."""
+        if not self.pipeline_chunk:
+            return super().verify_sig_shares_deferred(items)
+        c = self.counters
+        c.sig_shares_verified += len(items)
+        c.pairing_checks += len(items)
+        r = self.group.r
+        h2 = self.group.hash_to_g2
+
+        def compute(chunk):
+            return [
+                share.el % r == (pk.el * h2(doc)) % r for pk, doc, share in chunk
+            ]
+
+        _, finish = self._piped_submit(items, compute)
+        return lambda: self._traced("pairing", len(items), finish)
+
+    def verify_dec_shares_deferred(self, items):
+        if not self.pipeline_chunk:
+            return super().verify_dec_shares_deferred(items)
+        c = self.counters
+        c.dec_shares_verified += len(items)
+        c.pairing_checks += len(items)
+        r = self.group.r
+
+        def compute(chunk):
+            return [
+                (share.el * ct.hash_point()) % r == (pk.el * ct.w) % r
+                for pk, ct, share in chunk
+            ]
+
+        _, finish = self._piped_submit(items, compute)
+        return lambda: self._traced("pairing", len(items), finish)
 
 
 class CpuBackend(CryptoBackend):

@@ -193,7 +193,13 @@ class Ciphertext:
         return Ciphertext(group, u, v, w)
 
     def digest(self) -> bytes:
-        return hashlib.sha256(self.to_bytes()).digest()
+        """Memoized like :meth:`hash_point`: the batch verify paths use
+        the digest as their grouping key O(N³) times per epoch."""
+        cached = getattr(self, "_digest", None)
+        if cached is None:
+            cached = hashlib.sha256(self.to_bytes()).digest()
+            self._digest = cached
+        return cached
 
     def __eq__(self, other) -> bool:
         return (

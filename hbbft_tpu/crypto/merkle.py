@@ -132,6 +132,112 @@ class MerkleTree:
         )
 
 
+class PackedProofs:
+    """Every (tree, leaf-index) inclusion proof of many same-shape trees
+    as rectangular arrays — the array engine's N² proof workload without
+    N² ``Proof`` Python objects (value bytes + path tuples + per-proof
+    validate calls dominated the round-5 "host: everything else" bucket
+    at N=100; the packed form is a handful of numpy gathers per tree).
+
+    Row order is tree-major, leaf-index minor — identical to
+    ``[trees[p].proof(s) for p in ids for s in range(n_leaves)]`` — so
+    :meth:`validate` returns the same boolean list the object path does.
+    """
+
+    def __init__(self, leaves, paths, indices, roots, n_leaves: int) -> None:
+        self.leaves = leaves  # (T·n, leaf_len) uint8
+        self.paths = paths  # (T·n, depth, 32) uint8
+        self.indices = indices  # (T·n,) int32
+        self.roots = roots  # (T·n, 32) uint8
+        self.n_leaves = n_leaves
+
+    def __len__(self) -> int:
+        return self.leaves.shape[0]
+
+    @classmethod
+    def from_trees(
+        cls, trees: Sequence["MerkleTree"], n_leaves: int
+    ) -> Optional["PackedProofs"]:
+        """Pack all proofs of ``trees`` (each with ``n_leaves`` real
+        leaves of one uniform length).  Returns None when the native
+        SHA kernel is unavailable or the shapes don't fit its limits —
+        callers fall back to per-proof objects."""
+        import numpy as np
+
+        from hbbft_tpu import native
+
+        if not trees or not native.sha256_available():
+            return None
+        leaf_len = len(trees[0].leaves[0])
+        if leaf_len + 1 > 4096:
+            return None
+        for t in trees:
+            if len(t.leaves) != n_leaves or any(
+                len(v) != leaf_len for v in t.leaves
+            ):
+                return None
+        depth = _depth(n_leaves)
+        idx = np.arange(n_leaves, dtype=np.int64)
+        per_tree_paths = []
+        for t in trees:
+            # level d's sibling of leaf i is node (i >> d) ^ 1 — one
+            # gather per level instead of n_leaves Python proof walks
+            cols = []
+            for d in range(depth):
+                lvl = np.frombuffer(
+                    b"".join(t.levels[d]), dtype=np.uint8
+                ).reshape(len(t.levels[d]), 32)
+                cols.append(lvl[(idx >> d) ^ 1])
+            if depth:
+                per_tree_paths.append(np.stack(cols, axis=1))
+            else:
+                per_tree_paths.append(np.zeros((n_leaves, 0, 32), np.uint8))
+        leaves = np.frombuffer(
+            b"".join(b"".join(t.leaves) for t in trees), dtype=np.uint8
+        ).reshape(len(trees) * n_leaves, leaf_len)
+        paths = np.concatenate(per_tree_paths, axis=0)
+        indices = np.tile(
+            np.arange(n_leaves, dtype=np.int32), len(trees)
+        )
+        roots = np.repeat(
+            np.frombuffer(
+                b"".join(t.root_hash for t in trees), dtype=np.uint8
+            ).reshape(len(trees), 32),
+            n_leaves,
+            axis=0,
+        )
+        return cls(leaves, paths, indices, roots, n_leaves)
+
+    def validate(self, reps: int = 1) -> List[bool]:
+        """Validate every packed proof ``reps`` times through the C
+        SHA-NI kernel — same per-proof booleans (and the same repeated
+        hash WORKLOAD) as ``validate_proofs`` over the object form."""
+        from hbbft_tpu import native
+
+        ok = native.merkle_validate_batch(
+            self.leaves, self.paths, self.indices, self.roots, reps
+        )
+        if ok is None:  # kernel refused (shape limits): object fallback
+            out = []
+            for i in range(len(self)):
+                p = Proof(
+                    value=self.leaves[i].tobytes(),
+                    index=int(self.indices[i]),
+                    path=tuple(
+                        self.paths[i, d].tobytes()
+                        for d in range(self.paths.shape[1])
+                    ),
+                    root_hash=self.roots[i].tobytes(),
+                    n_leaves=self.n_leaves,
+                )
+                good = True
+                for _ in range(reps):
+                    good = p.validate(self.n_leaves)
+                out.append(good)
+            return out
+        return [bool(v) for v in ok]
+
+
 def validate_proofs(proofs: Sequence[Proof], n_leaves: int, reps: int = 1) -> List[bool]:
     """Batched proof validation: the array engine's hash entry point.
 

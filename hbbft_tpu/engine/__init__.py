@@ -5,6 +5,9 @@ executor that replaces per-message Python dispatch with per-round batched
 array/crypto operations.
 """
 
-from hbbft_tpu.engine.array_engine import ArrayHoneyBadgerNet
+from hbbft_tpu.engine.array_engine import (
+    ArrayHoneyBadgerNet,
+    EngineInvariantError,
+)
 
-__all__ = ["ArrayHoneyBadgerNet"]
+__all__ = ["ArrayHoneyBadgerNet", "EngineInvariantError"]

@@ -36,9 +36,25 @@ threshold (N−f Echo, f+1/2f+1 BVal, 2f+1 Ready, N−f Aux/Conf) crosses for
 all receivers in the same round, every RBC decodes in the same round, every
 BA instance receives input ``true`` in the same round and decides ``true``
 in its first round on the fixed coin (binary_agreement.py `_fixed_coin`:
-round 0 → true).  The engine executes exactly those transitions, asserting
-the thresholds it relies on, and produces the same `Batch` values the
-object engine emits under this schedule.
+round 0 → true).  The engine executes exactly those transitions, checking
+the thresholds it relies on with explicit raises, and produces the same
+`Batch` values the object engine emits under this schedule.
+
+**Host-side execution (PR 5).** Epoch host time is itemized into the
+``host_bucket_*`` counters (obs/hostbuckets.py regions: encode,
+rs_merkle, assemble, scatter, staging, dispatch, other) and the hot host
+paths are vectorized: item lists and result scatter use index arithmetic
+over the flat backend batches, the N² Merkle proofs pack into arrays
+(crypto/merkle.PackedProofs), the round-3 per-receiver RS reconstructs
+run once with accounting-only replication, and canonical encode/decode
+is batched.  Verification overlaps the NEXT round's assembly through the
+backends' deferred entry points (the ``verify_*_deferred`` seam riding
+ops/pipeline.py's bounded queue): combines are dispatched speculatively
+while the share checks execute, and a failed check still raises before
+any Batch is emitted.  ``HBBFT_TPU_NO_HOSTPIPE=1`` restores the legacy
+per-item loops and strictly ordered verification — Batches are
+bit-identical and ``device_dispatches`` unchanged either way (asserted
+in tests/test_host_buckets.py).
 
 Faulty/adversarial behaviour and latency models stay the object engine's
 job; the array engine targets the honest-path throughput configs.
@@ -54,10 +70,24 @@ from typing import Any, Dict, List, Optional, Sequence
 from hbbft_tpu.core.network_info import NetworkInfo
 from hbbft_tpu.crypto.backend import CryptoBackend, MockBackend
 from hbbft_tpu.crypto.erasure import rs_codec
-from hbbft_tpu.crypto.merkle import MerkleTree, _depth, validate_proofs
+from hbbft_tpu.crypto.merkle import MerkleTree, PackedProofs, _depth, validate_proofs
+from hbbft_tpu.ops.pipeline import hostpipe_enabled
 from hbbft_tpu.protocols.honey_badger import Batch
 from hbbft_tpu.utils import canonical
 from hbbft_tpu.utils.metrics import Counters
+
+
+class EngineInvariantError(RuntimeError):
+    """A lockstep invariant the engine relies on failed (honest-path
+    precondition violated, or a Byzantine input slipped into a
+    simulation run).  Raised explicitly — these checks used to be
+    ``assert`` statements, which silently vanish under ``python -O`` and
+    would turn the Byzantine-detection paths into no-ops."""
+
+
+def _require(cond: bool, msg: str) -> None:
+    if not cond:
+        raise EngineInvariantError(msg)
 
 
 @dataclass
@@ -184,6 +214,30 @@ class ArrayHoneyBadgerNet:
             out.extend(fn(items[i : i + self.verify_chunk]))
         return out
 
+    def _verify_deferred(self, kind: str, items: list):
+        """Deferred twin of :meth:`_verify_batch` — submits the chunks now
+        (behind the backend's bounded in-flight queue) and returns a
+        zero-arg resolver, so the NEXT round's item lists assemble while
+        this round's checks execute on device (cross-round pipelining;
+        kill switch ``HBBFT_TPU_NO_HOSTPIPE=1`` routes around it)."""
+        fn = {
+            "sig": self.backend.verify_sig_shares_deferred,
+            "dec": self.backend.verify_dec_shares_deferred,
+            "ct": self.backend.verify_ciphertexts_deferred,
+        }[kind]
+        resolvers = [
+            fn(items[i : i + self.verify_chunk])
+            for i in range(0, len(items), self.verify_chunk)
+        ]
+
+        def resolve() -> List[bool]:
+            out: List[bool] = []
+            for r in resolvers:
+                out.extend(r())
+            return out
+
+        return resolve
+
     # -- the epoch -----------------------------------------------------------
 
     def run_epoch(self, contributions: Dict[Any, bytes]) -> Dict[Any, Batch]:
@@ -192,9 +246,18 @@ class ArrayHoneyBadgerNet:
         ``contributions[node] -> bytes`` is each node's proposed payload
         (what QueueingHoneyBadger would sample from its transaction queue).
         """
+        # host-bucket attribution (obs/hostbuckets.py): the epoch region
+        # bills counters.host_seconds (wall minus device-fetch-blocked)
+        # and every phase below bills its named exclusive slice
+        with self.backend.buckets.epoch():
+            return self._run_epoch(contributions)
+
+    def _run_epoch(self, contributions: Dict[Any, bytes]) -> Dict[Any, Batch]:
         n, f = self.n, self.f
         rep = EpochReport(epoch=self.epoch)
         tr = self.tracer
+        bk = self.backend.buckets
+        fast = hostpipe_enabled()
         t_phase = 0.0
         if tr is not None:
             tr.begin(
@@ -209,12 +272,18 @@ class ArrayHoneyBadgerNet:
         # honey_badger.py propose(): canonical-encode the contribution
         # (wrapped in DHB's internal envelope in dynamic mode), then
         # threshold-encrypt.
-        msgs: List[bytes] = []
-        for nid in self.ids:
-            inner: Any = bytes(contributions[nid])
-            if self.dynamic:
-                inner = ("icontrib", inner, [], [])  # lists: match DHB propose()
-            msgs.append(canonical.encode(inner))
+        with bk.region("encode"):
+            inners: List[Any] = [
+                ("icontrib", bytes(contributions[nid]), [], [])  # match DHB propose()
+                if self.dynamic
+                else bytes(contributions[nid])
+                for nid in self.ids
+            ]
+            msgs = (
+                canonical.encode_batch(inners)
+                if fast
+                else [canonical.encode(x) for x in inners]
+            )
         # all N threshold-encryptions through the backend's batched
         # ladders (same math as pk_master.encrypt per node — ~0.85
         # s/epoch of sequential host EC at N=16, ~5 s at N=100,
@@ -222,26 +291,29 @@ class ArrayHoneyBadgerNet:
         from hbbft_tpu.engine.dkg_batch import batched_encrypt
 
         master_el = self.pk_master.el
-        ct_list = batched_encrypt(
-            self.backend, [master_el] * n, msgs, self.rng, kind="encrypt"
-        )
+        with bk.region("dispatch"):
+            ct_list = batched_encrypt(
+                self.backend, [master_el] * n, msgs, self.rng, kind="encrypt"
+            )
         for ct in ct_list:
             # receivers must pay their own hash-to-G2 in rounds 7-8
             # (the encryptor-side cache would make them free cache hits)
             if hasattr(ct, "_hash_point"):
                 del ct._hash_point
         cts: Dict[Any, Any] = dict(zip(self.ids, ct_list))
-        ct_bytes = {nid: cts[nid].to_bytes() for nid in self.ids}
+        with bk.region("encode"):
+            ct_bytes = {nid: cts[nid].to_bytes() for nid in self.ids}
 
         # broadcast.py broadcast(): frame, shard, commit.
         trees: Dict[Any, MerkleTree] = {}
         shards: Dict[Any, List[bytes]] = {}
-        for nid in self.ids:
-            framed = len(ct_bytes[nid]).to_bytes(4, "big") + ct_bytes[nid]
-            sh = self.codec.encode(framed)
-            shards[nid] = sh
-            trees[nid] = MerkleTree(sh)
-            rep.rs_encodes += 1
+        with bk.region("rs_merkle"):
+            for nid in self.ids:
+                framed = len(ct_bytes[nid]).to_bytes(4, "big") + ct_bytes[nid]
+                sh = self.codec.encode(framed)
+                shards[nid] = sh
+                trees[nid] = MerkleTree(sh)
+                rep.rs_encodes += 1
         tree_size = 1 << _depth(n)  # trees pad to a power of two
         rep.hashes += n * (2 * tree_size - 1)
         self._count_msgs(rep, n * (n - 1))  # Value: point-to-point
@@ -250,16 +322,33 @@ class ArrayHoneyBadgerNet:
         # The N² distinct (instance, shard-index) proofs; each is validated
         # many times across receivers/phases — the repetition count is
         # passed down so the batched hasher repeats the WORK without
-        # materializing millions of identical Python objects.
-        proofs = [trees[p].proof(s) for p in self.ids for s in range(n)]
+        # materializing millions of identical Python objects.  Fast path:
+        # the proofs never exist as objects at all — array slices of the
+        # tree levels feed the C kernel directly (PackedProofs).
+        proofs: Optional[List] = None
+        packed: Optional[PackedProofs] = None
+        with bk.region("rs_merkle"):
+            if fast:
+                packed = PackedProofs.from_trees(
+                    [trees[p] for p in self.ids], n
+                )
+            if packed is None:
+                proofs = [trees[p].proof(s) for p in self.ids for s in range(n)]
+        n_proofs = n * n
+
+        def _validate_all(reps: int) -> List[bool]:
+            if packed is not None:
+                return packed.validate(reps=reps)
+            return validate_proofs(proofs, n, reps=reps)
 
         # ------ round 1: validate own Value proof, send Echo ---------------
         # broadcast.py _handle_value → _validate_proof(own index): each
         # receiver checks the one proof addressed to it (N² total).
-        ok = validate_proofs(proofs, n, reps=1)
-        assert all(ok), "array engine: proposer produced an invalid proof"
-        rep.proofs_validated += len(proofs)
-        rep.hashes += len(proofs) * (_depth(n) + 1)
+        with bk.region("rs_merkle"):
+            ok = _validate_all(1)
+        _require(all(ok), "array engine: proposer produced an invalid proof")
+        rep.proofs_validated += n_proofs
+        rep.hashes += n_proofs * (_depth(n) + 1)
         self._count_msgs(rep, n * n * (n - 1))  # Echo: Target.all per node
         rep.rounds += 1
 
@@ -268,12 +357,13 @@ class ArrayHoneyBadgerNet:
         # shard proof (the O(N³) hash hot loop, batched here: N² distinct
         # proofs × N receivers each).
         reps = 1 if self.dedup_verifies else n
-        ok = validate_proofs(proofs, n, reps=reps)
-        assert all(ok), "array engine: honest echo failed validation"
-        rep.proofs_validated += len(proofs) * reps
-        rep.hashes += len(proofs) * reps * (_depth(n) + 1)
+        with bk.region("rs_merkle"):
+            ok = _validate_all(reps)
+        _require(all(ok), "array engine: honest echo failed validation")
+        rep.proofs_validated += n_proofs * reps
+        rep.hashes += n_proofs * reps * (_depth(n) + 1)
         # Echo count n ≥ N−f for every (instance, receiver): send Ready.
-        assert n >= n - f
+        _require(n >= n - f, "array engine: Echo quorum short")
         self._count_msgs(rep, n * n * (n - 1))  # Ready: Target.all
         rep.rounds += 1
 
@@ -283,25 +373,34 @@ class ArrayHoneyBadgerNet:
         values: Dict[Any, bytes] = {}
         reps = 1 if self.dedup_verifies else n
         full_shards: Dict[Any, List[bytes]] = {}
-        for p in self.ids:
-            # every receiver performs this reconstruction:
-            for _ in range(reps):
-                full = self.codec.reconstruct(list(shards[p]))
-            full_shards[p] = full
-            framed = b"".join(full[: self.codec.k])
-            length = int.from_bytes(framed[:4], "big")
-            values[p] = framed[4 : 4 + length]
-            rep.rs_reconstructs += reps
-            rep.hashes += reps * (2 * tree_size - 1)
-        # ... and the Merkle re-commit of the reconstructed shard vector,
-        # batched across instances through the C hash kernel.
-        roots = _roots_batch(
-            [full_shards[p] for p in self.ids], reps
-        )
+        with bk.region("rs_merkle"):
+            for p in self.ids:
+                if fast:
+                    # every receiver performs this identical all-present
+                    # reconstruction — ONE pass through the (native GFNI)
+                    # codec, replicated in ACCOUNTING only
+                    full = self.codec.reconstruct(list(shards[p]))
+                else:
+                    for _ in range(reps):
+                        full = self.codec.reconstruct(list(shards[p]))
+                full_shards[p] = full
+                framed = b"".join(full[: self.codec.k])
+                length = int.from_bytes(framed[:4], "big")
+                values[p] = framed[4 : 4 + length]
+                rep.rs_reconstructs += reps
+                rep.hashes += reps * (2 * tree_size - 1)
+            # ... and the Merkle re-commit of the reconstructed shard
+            # vector, batched across instances through the C hash kernel.
+            roots = _roots_batch(
+                [full_shards[p] for p in self.ids], reps
+            )
         for p, root in zip(self.ids, roots):
-            assert root == trees[p].root_hash
+            _require(
+                root == trees[p].root_hash,
+                "array engine: reconstructed root mismatch",
+            )
         for p in self.ids:
-            assert values[p] == ct_bytes[p], "RBC value mismatch"
+            _require(values[p] == ct_bytes[p], "RBC value mismatch")
         if tr is not None:
             # per-proposer RBC instance spans: in the lockstep schedule all
             # N instances cover the same wall interval, one per track
@@ -320,7 +419,7 @@ class ArrayHoneyBadgerNet:
         rep.rounds += 1
 
         # ------ round 4: BVal threshold (2f+1) → bin_values, Aux -----------
-        assert n >= 2 * f + 1
+        _require(n >= 2 * f + 1, "array engine: BVal threshold short")
         self._count_msgs(rep, n * n * (n - 1))  # Aux
         rep.rounds += 1
 
@@ -359,32 +458,53 @@ class ArrayHoneyBadgerNet:
         # honey_badger.py: SubsetOutput::Contribution(p, ct) → spawn
         # ThresholdDecrypt(p); set_ciphertext defers a verify_ciphertext
         # item per (receiver, proposer).
-        ct_items = []
-        for p in self.ids:
-            ct_obj = cts[p]
-            reps = 1 if self.dedup_verifies else n
-            ct_items.extend([ct_obj] * reps)
-        ok = self._verify_batch("ct", ct_items)
-        assert all(ok), "array engine: honest ciphertext failed validation"
+        reps = 1 if self.dedup_verifies else n
+        with bk.region("assemble"):
+            ct_items = [cts[p] for p in self.ids for _ in range(reps)]
+        ct_resolve = None
+        if fast:
+            # deferred: the ciphertext pairings execute behind the queue
+            # while the decrypt-share round assembles below
+            with bk.region("dispatch"):
+                ct_resolve = self._verify_deferred("ct", ct_items)
+        else:
+            with bk.region("dispatch"):
+                ok = self._verify_batch("ct", ct_items)
+            _require(
+                all(ok), "array engine: honest ciphertext failed validation"
+            )
         rep.ciphertexts_verified += len(ct_items)
         # threshold_decrypt.py start_decryption: every node multicasts its
         # decryption share for every accepted proposer — all N² scalar
         # mults through the backend's batched ladder (one device dispatch
         # on TpuBackend).
-        gen_items = [
-            (self.netinfos[s].secret_key_share, cts[p])
-            for p in self.ids
-            for s in self.ids
-        ]
-        gen_out = self.backend.decrypt_shares_batch(gen_items)
-        dec_shares: Dict[Any, Dict[int, Any]] = {}
-        pos = 0
-        for p in self.ids:
-            per_sender: Dict[int, Any] = {}
-            for s_idx in range(n):
-                per_sender[s_idx] = gen_out[pos]
-                pos += 1
-            dec_shares[p] = per_sender
+        with bk.region("assemble"):
+            sk_shares = [self.netinfos[s].secret_key_share for s in self.ids]
+            gen_items = [(sk, cts[p]) for p in self.ids for sk in sk_shares]
+        with bk.region("dispatch"):
+            gen_out = self.backend.decrypt_shares_batch(gen_items)
+        if ct_resolve is not None:
+            # resolved AFTER the decrypt dispatches that overlapped it; a
+            # bad ciphertext still raises before any Batch is emitted
+            with bk.region("dispatch"):
+                ok = ct_resolve()
+            _require(
+                all(ok), "array engine: honest ciphertext failed validation"
+            )
+        dec_shares: Optional[Dict[Any, Dict[int, Any]]] = None
+        if not fast:
+            # legacy scatter: flat ladder output → per-(proposer, sender)
+            # dicts via a pos cursor.  The fast path never materializes
+            # them — round 8 indexes gen_out[p_idx*n + s_idx] directly.
+            with bk.region("scatter"):
+                dec_shares = {}
+                pos = 0
+                for p in self.ids:
+                    per_sender: Dict[int, Any] = {}
+                    for s_idx in range(n):
+                        per_sender[s_idx] = gen_out[pos]
+                        pos += 1
+                    dec_shares[p] = per_sender
         self._count_msgs(rep, n * n * (n - 1))  # dec shares: Target.all
         rep.rounds += 1
 
@@ -392,54 +512,96 @@ class ArrayHoneyBadgerNet:
         # threshold_decrypt.py handle_message: every receiver verifies every
         # other sender's share (own share is trusted) — the O(N³) pairing
         # hot loop, one batched backend dispatch.
-        items = []
-        for p in self.ids:
-            for s_idx in range(n):
-                pk_share = self.pk_shares[s_idx]
-                item = (pk_share, cts[p], dec_shares[p][s_idx])
-                reps = 1 if self.dedup_verifies else n - 1
-                items.extend([item] * reps)
-        ok = self._verify_batch("dec", items)
-        assert all(ok), "array engine: honest decryption share rejected"
+        reps = 1 if self.dedup_verifies else n - 1
+        with bk.region("assemble"):
+            if fast:
+                distinct = [
+                    (self.pk_shares[s_idx], cts[p], gen_out[p_idx * n + s_idx])
+                    for p_idx, p in enumerate(self.ids)
+                    for s_idx in range(n)
+                ]
+                items = [it for it in distinct for _ in range(reps)]
+            else:
+                items = []
+                for p in self.ids:
+                    for s_idx in range(n):
+                        pk_share = self.pk_shares[s_idx]
+                        item = (pk_share, cts[p], dec_shares[p][s_idx])
+                        items.extend([item] * reps)
+        dec_resolve = None
+        if fast:
+            with bk.region("dispatch"):
+                dec_resolve = self._verify_deferred("dec", items)
+        else:
+            with bk.region("dispatch"):
+                ok = self._verify_batch("dec", items)
+            _require(
+                all(ok), "array engine: honest decryption share rejected"
+            )
         rep.dec_shares_verified += len(items)
 
         # _try_combine: threshold+1 lowest-indexed verified shares.  Every
         # receiver combines independently — all N² combines go through the
-        # backend's batched API (one device dispatch on TpuBackend).
+        # backend's batched API (one device dispatch on TpuBackend).  Fast
+        # path: combines are dispatched while the share verification above
+        # is still in flight (speculative under the honest schedule — a
+        # rejected share raises below, before batch emission).
         reps = 1 if self.dedup_verifies else n
-        combine_items = []
-        for p in self.ids:
-            chosen = {
-                i: dec_shares[p][i] for i in range(self.threshold + 1)
-            }
-            combine_items.extend([(chosen, cts[p])] * reps)
+        k = self.threshold + 1
+        with bk.region("assemble"):
+            combine_items = []
+            for p_idx, p in enumerate(self.ids):
+                if fast:
+                    chosen = {
+                        i: gen_out[p_idx * n + i] for i in range(k)
+                    }
+                else:
+                    chosen = {i: dec_shares[p][i] for i in range(k)}
+                combine_items.extend([(chosen, cts[p])] * reps)
         plains: List[bytes] = []
-        for i in range(0, len(combine_items), self.verify_chunk):
-            plains.extend(
-                self.backend.combine_dec_shares_batch(
-                    self.pk_set, combine_items[i : i + self.verify_chunk]
+        with bk.region("dispatch"):
+            for i in range(0, len(combine_items), self.verify_chunk):
+                plains.extend(
+                    self.backend.combine_dec_shares_batch(
+                        self.pk_set, combine_items[i : i + self.verify_chunk]
+                    )
                 )
-            )
         rep.combines += len(combine_items)
+        if dec_resolve is not None:
+            with bk.region("dispatch"):
+                ok = dec_resolve()
+            _require(
+                all(ok), "array engine: honest decryption share rejected"
+            )
         plain: Dict[Any, bytes] = {}
-        for j, p in enumerate(self.ids):
-            pt = plains[j * reps]
-            assert pt is not None, "array engine: combine failed"
-            plain[p] = pt
+        with bk.region("scatter"):
+            for j, p in enumerate(self.ids):
+                pt = plains[j * reps]
+                _require(pt is not None, "array engine: combine failed")
+                plain[p] = pt
         # honey_badger.py batch emission: canonical-decode each plaintext;
         # in dynamic mode additionally unwrap DHB's internal envelope
         # (dynamic_honey_badger.py _on_hb_batch — its batched per-batch
         # signature verification runs over the votes/key-gen lists, which
         # are empty in the no-churn steady state).
         decoded: Dict[Any, bytes] = {}
-        for p in self.ids:
-            tree = canonical.decode(plain[p])
-            if self.dynamic:
-                tag, user, votes, kg = tree
-                assert tag == "icontrib" and votes == [] and kg == []
-                tree = user
-            assert tree == bytes(contributions[p]), "decrypt mismatch"
-            decoded[p] = tree
+        with bk.region("encode"):
+            plain_list = [plain[p] for p in self.ids]
+            trees_out = (
+                canonical.decode_batch(plain_list)
+                if fast
+                else [canonical.decode(b) for b in plain_list]
+            )
+            for p, tree in zip(self.ids, trees_out):
+                if self.dynamic:
+                    tag, user, votes, kg = tree
+                    _require(
+                        tag == "icontrib" and votes == [] and kg == [],
+                        "array engine: DHB envelope mismatch",
+                    )
+                    tree = user
+                _require(tree == bytes(contributions[p]), "decrypt mismatch")
+                decoded[p] = tree
         rep.rounds += 1
         if tr is not None:
             tr.end()  # decrypt
@@ -467,71 +629,119 @@ class ArrayHoneyBadgerNet:
         * combine: every receiver Lagrange-combines f+1 verified shares
                    (N per instance; dedup: 1) and takes sig.parity()
 
-        All receivers must derive the SAME bit — asserted per instance.
+        All receivers must derive the SAME bit — checked per instance.
+        Fast path (``hostpipe_enabled``): flat index arithmetic replaces
+        the per-instance share dicts, and the combine assembly overlaps
+        the deferred share verification.
         """
         tr = self.tracer
+        bk = self.backend.buckets
+        fast = hostpipe_enabled()
         if tr is not None:
             tr.begin(f"coin_round:{round_no}", cat="coin", round=round_no)
         n = self.n
-        docs = [
-            canonical.encode(("coin", self.epoch, p_idx, round_no))
-            for p_idx in range(n)
-        ]
+        with bk.region("encode"):
+            docs = [
+                canonical.encode(("coin", self.epoch, p_idx, round_no))
+                for p_idx in range(n)
+            ]
         # SBV re-exchange for this BA round, then the share broadcast.
         self._count_msgs(rep, 4 * n * n * (n - 1))  # BVal, Aux, Conf, share
-        sign_items = [
-            (self.netinfos[s].secret_key_share, docs[p_idx])
-            for p_idx in range(n)
-            for s in self.ids
-        ]
-        shares_flat = self.backend.sign_shares_batch(sign_items)
+        with bk.region("assemble"):
+            sk_shares = [self.netinfos[s].secret_key_share for s in self.ids]
+            sign_items = [
+                (sk, docs[p_idx]) for p_idx in range(n) for sk in sk_shares
+            ]
+        with bk.region("dispatch"):
+            shares_flat = self.backend.sign_shares_batch(sign_items)
         rep.coin_signs += len(sign_items)
-        shares: List[Dict[int, Any]] = []
-        pos = 0
-        for p_idx in range(n):
-            shares.append({s_idx: shares_flat[pos + s_idx] for s_idx in range(n)})
-            pos += n
+        shares: Optional[List[Dict[int, Any]]] = None
+        if not fast:
+            with bk.region("scatter"):
+                shares = []
+                pos = 0
+                for p_idx in range(n):
+                    shares.append(
+                        {s_idx: shares_flat[pos + s_idx] for s_idx in range(n)}
+                    )
+                    pos += n
         # per-receiver share verification (own share trusted).
         reps = 1 if self.dedup_verifies else n - 1
-        items = []
-        for p_idx in range(n):
-            for s_idx in range(n):
-                item = (
-                    self.pk_shares[s_idx],
-                    docs[p_idx],
-                    shares[p_idx][s_idx],
-                )
-                items.extend([item] * reps)
-        ok = self._verify_batch("sig", items)
-        assert all(ok), "array engine: honest coin share rejected"
+        with bk.region("assemble"):
+            if fast:
+                distinct = [
+                    (self.pk_shares[s_idx], docs[p_idx],
+                     shares_flat[p_idx * n + s_idx])
+                    for p_idx in range(n)
+                    for s_idx in range(n)
+                ]
+                items = [it for it in distinct for _ in range(reps)]
+            else:
+                items = []
+                for p_idx in range(n):
+                    for s_idx in range(n):
+                        item = (
+                            self.pk_shares[s_idx],
+                            docs[p_idx],
+                            shares[p_idx][s_idx],
+                        )
+                        items.extend([item] * reps)
+        sig_resolve = None
+        if fast:
+            with bk.region("dispatch"):
+                sig_resolve = self._verify_deferred("sig", items)
+        else:
+            with bk.region("dispatch"):
+                ok = self._verify_batch("sig", items)
+            _require(all(ok), "array engine: honest coin share rejected")
         rep.sig_shares_verified += len(items)
         # per-receiver combine: receiver i uses the f+1 verified shares
         # with the lowest indices starting at its own (subsets differ by
         # receiver; the combined signature must not).
         k = self.threshold + 1
-        combine_items = []
-        per_instance_slots: List[List[int]] = []
-        for p_idx in range(n):
-            slots = []
-            for recv in range(1 if self.dedup_verifies else n):
-                chosen = {
-                    (recv + j) % n: shares[p_idx][(recv + j) % n]
-                    for j in range(k)
-                }
-                slots.append(len(combine_items))
-                combine_items.append((chosen, None))
-            per_instance_slots.append(slots)
+        with bk.region("assemble"):
+            combine_items = []
+            per_instance_slots: List[List[int]] = []
+            for p_idx in range(n):
+                slots = []
+                for recv in range(1 if self.dedup_verifies else n):
+                    if fast:
+                        chosen = {
+                            (recv + j) % n: shares_flat[
+                                p_idx * n + (recv + j) % n
+                            ]
+                            for j in range(k)
+                        }
+                    else:
+                        chosen = {
+                            (recv + j) % n: shares[p_idx][(recv + j) % n]
+                            for j in range(k)
+                        }
+                    slots.append(len(combine_items))
+                    combine_items.append((chosen, None))
+                per_instance_slots.append(slots)
         sigs = []
-        for i in range(0, len(combine_items), self.verify_chunk):
-            sigs.extend(
-                self.backend.combine_sig_shares_batch(
-                    self.pk_set, combine_items[i : i + self.verify_chunk]
+        with bk.region("dispatch"):
+            for i in range(0, len(combine_items), self.verify_chunk):
+                sigs.extend(
+                    self.backend.combine_sig_shares_batch(
+                        self.pk_set, combine_items[i : i + self.verify_chunk]
+                    )
                 )
-            )
         rep.sig_combines += len(combine_items)
-        for p_idx in range(n):
-            bits = {sigs[slot].parity() for slot in per_instance_slots[p_idx]}
-            assert len(bits) == 1, "array engine: coin bit disagreement"
+        if sig_resolve is not None:
+            with bk.region("dispatch"):
+                ok = sig_resolve()
+            _require(all(ok), "array engine: honest coin share rejected")
+        with bk.region("scatter"):
+            for p_idx in range(n):
+                bits = {
+                    sigs[slot].parity()
+                    for slot in per_instance_slots[p_idx]
+                }
+                _require(
+                    len(bits) == 1, "array engine: coin bit disagreement"
+                )
         rep.coin_rounds += 1
         rep.rounds += 1
         if tr is not None:
@@ -553,31 +763,39 @@ class ArrayHoneyBadgerNet:
            the churn bench row measures).
         3. **Era turnover**: each node's generate() must agree on the new
            PublicKeySet; NetworkInfo is rebuilt with the new key shares,
-           era += 1.  The NEXT run_epoch's decrypt-equality asserts prove
+           era += 1.  The NEXT run_epoch's decrypt-equality checks prove
            consensus still holds under the new keys.
 
         Returns the work report (also appended to ``churn_reports``).
         """
+        with self.backend.buckets.epoch():
+            return self._era_change()
+
+    def _era_change(self) -> EpochReport:
         n, f = self.n, self.f
         rep = EpochReport(epoch=self.epoch)
+        bk = self.backend.buckets
         g = self.backend.group
 
         # 1) signed votes, batch-verified per receiver (ride inside one
         # epoch's contributions, so no extra message rounds).
-        vote_doc = canonical.encode(("vote", self.era, "rotate-keys"))
+        with bk.region("encode"):
+            vote_doc = canonical.encode(("vote", self.era, "rotate-keys"))
         vote_sigs = {
             nid: self.netinfos[nid].secret_key.sign(vote_doc)
             for nid in self.ids
         }
         reps = 1 if self.dedup_verifies else n - 1
         pub_keys = self.netinfos[self.ids[0]].public_key_map()
-        vote_items = [
-            (pub_keys[nid], vote_doc, vote_sigs[nid])
-            for nid in self.ids
-            for _ in range(reps)
-        ]
-        ok = self.backend.verify_signatures(vote_items)
-        assert all(ok), "array engine: honest vote rejected"
+        with bk.region("assemble"):
+            vote_items = [
+                (pub_keys[nid], vote_doc, vote_sigs[nid])
+                for nid in self.ids
+                for _ in range(reps)
+            ]
+        with bk.region("dispatch"):
+            ok = self.backend.verify_signatures(vote_items)
+        _require(all(ok), "array engine: honest vote rejected")
         rep.votes_verified += len(vote_items)
 
         # 2) full SyncKeyGen among all N (lockstep Part then Ack phases).
@@ -597,14 +815,15 @@ class ArrayHoneyBadgerNet:
 
             self._count_msgs(rep, n * (n - 1))  # Part: Target.All
             self._count_msgs(rep, n * n * (n - 1))  # Ack: Target.All
-            first, shares, kstats = batched_era_dkg(
-                self.backend,
-                self.ids,
-                {nid: self.netinfos[nid].secret_key.x for nid in self.ids},
-                {nid: pub_keys[nid].el for nid in self.ids},
-                f,
-                self.rng,
-            )
+            with bk.region("dispatch"):
+                first, shares, kstats = batched_era_dkg(
+                    self.backend,
+                    self.ids,
+                    {nid: self.netinfos[nid].secret_key.x for nid in self.ids},
+                    {nid: pub_keys[nid].el for nid in self.ids},
+                    f,
+                    self.rng,
+                )
             rep.kg_parts_handled += kstats.parts_handled
             rep.kg_acks_handled += kstats.acks_handled
             rep.ciphertexts_verified += kstats.ciphertexts_verified
@@ -629,7 +848,7 @@ class ArrayHoneyBadgerNet:
                     out = kgs[nid].handle_part(
                         proposer, parts[proposer], self.rng
                     )
-                    assert out.fault is None, out.fault
+                    _require(out.fault is None, str(out.fault))
                     if out.ack is not None:
                         acks.append((nid, out.ack))
                     rep.kg_parts_handled += 1
@@ -637,15 +856,16 @@ class ArrayHoneyBadgerNet:
             for acker, ack in acks:
                 for nid in self.ids:
                     out = kgs[nid].handle_ack(acker, ack)
-                    assert out.fault is None, out.fault
+                    _require(out.fault is None, str(out.fault))
                     rep.kg_acks_handled += 1
             rep.rounds += 2
             results = {nid: kgs[nid].generate() for nid in self.ids}
 
         # 3) era turnover: everyone must derive the same key set.
         first = results[self.ids[0]][0]
-        assert all(results[nid][0] == first for nid in self.ids), (
-            "array engine: DKG public key set disagreement"
+        _require(
+            all(results[nid][0] == first for nid in self.ids),
+            "array engine: DKG public key set disagreement",
         )
         secret_keys = {nid: self.netinfos[nid].secret_key for nid in self.ids}
         self.netinfos = {

@@ -23,12 +23,15 @@ lockstep engine, ``--trace PATH`` / ``HBBFT_TPU_TRACE=PATH`` on
 
 from hbbft_tpu.obs.health import HealthReporter, render_why_stalled, why_stalled
 from hbbft_tpu.obs.histogram import Histogram
+from hbbft_tpu.obs.hostbuckets import HOST_BUCKETS, HostBuckets
 from hbbft_tpu.obs.tracer import Tracer
 
 __all__ = [
     "Tracer",
     "Histogram",
     "HealthReporter",
+    "HostBuckets",
+    "HOST_BUCKETS",
     "why_stalled",
     "render_why_stalled",
 ]

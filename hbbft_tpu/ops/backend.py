@@ -64,7 +64,11 @@ from hbbft_tpu.crypto.keys import (
     SignatureShare,
 )
 from hbbft_tpu.ops import curve, pairing, tower
-from hbbft_tpu.ops.pipeline import DispatchPipeline, fetch_to_host
+from hbbft_tpu.ops.pipeline import (
+    DispatchPipeline,
+    fetch_to_host,
+    hostpipe_enabled,
+)
 from hbbft_tpu.ops.staging import StagingCache
 
 _MIN_BUCKET = 4
@@ -208,6 +212,14 @@ class TpuBackend(CryptoBackend):
             counters=self.counters, tracer_ref=lambda: self.tracer
         )
         self._stage = StagingCache(counters=self.counters)
+        # Lagrange-combine prep memo: the engine's N² combines per epoch
+        # all interpolate over the SAME share indices (the lowest f+1),
+        # and the (bits, negs) ladder form is a pure function of those
+        # indices and the GLV arm — one Babai decomposition + bit packing
+        # serves the whole epoch.  Keyed (prep fn, index tuple, glv) so
+        # the in-process A/B arms can never alias; bounded like the
+        # lagrange coefficient cache it sits on top of.
+        self._prep_memo: Dict[Any, Tuple[np.ndarray, np.ndarray]] = {}
 
     def flush(self) -> None:
         """Resolve every pending (dispatched-but-unfetched) chunk.  All
@@ -226,12 +238,20 @@ class TpuBackend(CryptoBackend):
         """Time one host staging block (limb packing, scalars_to_bits,
         point conversion, placement) into counters.host_assembly_seconds
         — the quantity the pipeline overlaps with device execution.
-        Not nested: each dispatch site wraps exactly its own staging."""
+        Not nested: each dispatch site wraps exactly its own staging.
+
+        Also a ``staging`` host-bucket region (obs/hostbuckets.py): when
+        the engine's epoch wraps this call, the block's exclusive time
+        lands in ``host_bucket_staging`` instead of the enclosing
+        ``dispatch`` bucket."""
         t0 = time.perf_counter()
-        try:
-            yield
-        finally:
-            self.counters.host_assembly_seconds += time.perf_counter() - t0
+        with self.buckets.region("staging"):
+            try:
+                yield
+            finally:
+                self.counters.host_assembly_seconds += (
+                    time.perf_counter() - t0
+                )
 
     def _pad_bucket(self, n: int) -> int:
         """Bucket size for a batch/group axis.  MeshBackend widens this
@@ -275,6 +295,44 @@ class TpuBackend(CryptoBackend):
     def _place(self, tree):
         """Placement hook for jitted-call inputs (MeshBackend shards)."""
         return tree
+
+    def _to_device_gather(self, points, to_device, transform=None):
+        """Stage ``points`` with identity-deduplicated conversion.
+
+        The engine's per-receiver workload replicates the SAME Python
+        point objects N−1 times per lane (index arithmetic over the flat
+        batch), so the limb conversion + cache lookups need only run per
+        DISTINCT object — the full-width arrays are rebuilt with one
+        numpy-index gather per coordinate plane.  ``transform`` (e.g.
+        g1_neg) is applied per distinct point, after the dedup, so
+        derived tuples cannot defeat it.  Value-identical points that
+        are distinct objects simply miss the shortcut and fall through
+        to the staging cache's value dedup — results are identical.
+        The expansion happens host-side (numpy fancy indexing inside the
+        converters' ``gather=``) — an unjitted device gather would
+        trigger a per-shape XLA compile and dwarf the saving.  Legacy
+        arm (``HBBFT_TPU_NO_HOSTPIPE=1``): straight per-lane conversion,
+        as before this PR."""
+        def conv(pts, gather=None):
+            if transform is not None:
+                pts = [transform(p) if p is not None else None for p in pts]
+            return to_device(pts, cache=self._stage, gather=gather)
+
+        if not hostpipe_enabled() or len(points) <= 1:
+            return conv(list(points))
+        index: Dict[Any, int] = {}
+        order: List[Any] = []
+        idx = np.empty(len(points), dtype=np.int64)
+        for j, p in enumerate(points):
+            key = None if p is None else id(p)
+            pos = index.get(key)
+            if pos is None:
+                pos = index[key] = len(order)
+                order.append(p)
+            idx[j] = pos
+        if len(order) == len(points):
+            return conv(order)
+        return conv(order, gather=idx)
 
     # -- internals -----------------------------------------------------------
 
@@ -337,19 +395,30 @@ class TpuBackend(CryptoBackend):
         chunk = chunk + [pad] * (b - n)
         neg = self.group.g1_neg
         with self._host_assembly():
-            cache = self._stage
-            P1 = pairing.g1_affine_to_device([q[0] for q in chunk], cache=cache)
-            Q1 = pairing.g2_affine_to_device([q[1] for q in chunk], cache=cache)
-            P2 = pairing.g1_affine_to_device(
-                [neg(q[2]) if q[2] is not None else None for q in chunk],
-                cache=cache,
+            P1 = self._to_device_gather(
+                [q[0] for q in chunk], pairing.g1_affine_to_device
             )
-            Q2 = pairing.g2_affine_to_device([q[3] for q in chunk], cache=cache)
+            Q1 = self._to_device_gather(
+                [q[1] for q in chunk], pairing.g2_affine_to_device
+            )
+            # negation runs per DISTINCT point, after the dedup — a
+            # per-lane neg() would mint fresh tuples and defeat it
+            P2 = self._to_device_gather(
+                [q[2] for q in chunk], pairing.g1_affine_to_device,
+                transform=neg,
+            )
+            Q2 = self._to_device_gather(
+                [q[3] for q in chunk], pairing.g2_affine_to_device
+            )
             placed = self._place((P1, Q1, P2, Q2))
 
         def deliver(f, base=base, n=n):
-            for i in range(n):
-                write(base + i, pairing.is_one_host(f, i))
+            if hostpipe_enabled():
+                for i, ok in enumerate(pairing.is_one_host_batch(f, n)):
+                    write(base + i, ok)
+            else:  # legacy per-lane CRT readback (A/B kill-switch arm)
+                for i in range(n):
+                    write(base + i, pairing.is_one_host(f, i))
 
         self._dispatch_async(
             _jitted_product2(), placed, kind="pairing", items=n,
@@ -443,7 +512,8 @@ class TpuBackend(CryptoBackend):
         results: List,
         direct_quad,
         kind: str = "",
-    ) -> None:
+        deferred: bool = False,
+    ):
         """Run RLC group checks; write per-item booleans into `results`.
 
         `build_group_arrays(flat_padded_groups, g, k, group_keys) -> args`
@@ -469,6 +539,12 @@ class TpuBackend(CryptoBackend):
         leaves submit their exact per-item checks asynchronously the
         round they appear — the leaf pairing executes on device while
         the next bisection round's arrays assemble on host.
+
+        ``deferred=True`` (the engine's cross-round overlap) instead
+        submits the FIRST round behind the in-flight queue and returns a
+        zero-arg resumer; calling it processes the verdicts and runs any
+        remaining bisection rounds synchronously.  Returns None in sync
+        mode.
         """
         pending = [list(grp) for grp in groups if grp]
         tr = self.tracer
@@ -476,67 +552,174 @@ class TpuBackend(CryptoBackend):
             h = tr.hist("rlc_group_size")
             for grp in pending:
                 h.record(len(grp))
-        while pending:
-            with self._host_assembly():
-                k = _bucket(max(len(grp) for grp in pending))
-                g = self._pad_bucket(len(pending))
-                pad_group = [None] * k
-                padded: List[List[Optional[int]]] = [
-                    list(grp) + [None] * (k - len(grp)) for grp in pending
-                ] + [pad_group] * (g - len(pending))
+        if deferred and pending:
+            # Cross-round overlap (PR 5): submit the FIRST round's group
+            # check behind the bounded queue and hand back a resolver —
+            # the engine assembles the next round's item lists while the
+            # check executes.  Bisection (a failing group) resumes
+            # synchronously inside the resolver; the honest path is one
+            # deferred dispatch.  Dispatch counts are identical to the
+            # sync loop: same rounds, same chunks, only the first fetch
+            # is deferred.
+            placed, n_items = self._rlc_round_stage(pending, build_group_arrays)
+            holder: List[Any] = []
+            self.counters.device_dispatches += 1
+            self._dispatch_async(
+                jitted, placed, kind=kind, items=n_items,
+                on_result=holder.append,
+            )
 
+            def resume():
+                if not holder:
+                    self._pipe.flush()
+                nxt = self._rlc_process_round(
+                    pending, holder[0], items, results, direct_quad
+                )
+                self._rlc_rounds(
+                    nxt, items, build_group_arrays, jitted, results,
+                    direct_quad, kind,
+                )
+
+            return resume
+        self._rlc_rounds(
+            pending, items, build_group_arrays, jitted, results,
+            direct_quad, kind,
+        )
+        return None
+
+    def _rlc_rounds(
+        self, pending, items, build_group_arrays, jitted, results,
+        direct_quad, kind,
+    ) -> None:
+        """The synchronous bisection loop: one SYNC group dispatch per
+        round (control flow needs the verdicts) until no group remains."""
+        while pending:
+            placed, n_items = self._rlc_round_stage(pending, build_group_arrays)
+            self.counters.device_dispatches += 1
+            f = self._dispatch_fetch(
+                jitted, placed, kind=kind, items=n_items,
+            )
+            pending = self._rlc_process_round(
+                pending, f, items, results, direct_quad
+            )
+        self._pipe.flush()
+
+    def _rlc_round_stage(self, pending, build_group_arrays):
+        """Stage one bisection round's arrays: pad groups, draw fresh RLC
+        coefficients (one flattened ``scalars_to_bits`` call for the
+        whole (g, k) matrix), build the group point arrays, place."""
+        with self._host_assembly():
+            k = _bucket(max(len(grp) for grp in pending))
+            g = self._pad_bucket(len(pending))
+            pad_group = [None] * k
+            padded: List[List[Optional[int]]] = [
+                list(grp) + [None] * (k - len(grp)) for grp in pending
+            ] + [pad_group] * (g - len(pending))
+
+            if hostpipe_enabled():
+                # one entropy draw for the whole round's (g, k) matrix —
+                # the per-coefficient urandom syscall was a visible slice
+                # of the legacy dispatch bucket at N³ verify shapes
+                bits = self._rlc_bits()
+                top = (1 << bits) - 1
+                nb = (bits + 7) // 8
+                buf = os.urandom(nb * k * len(padded))
+                flat_rs = [
+                    1 + int.from_bytes(buf[o : o + nb], "big") % top
+                    for o in range(0, len(buf), nb)
+                ]
+                scalars = [
+                    flat_rs[gi * k + j] if idx is not None else 0
+                    for gi, grp in enumerate(padded)
+                    for j, idx in enumerate(grp)
+                ]
+            else:
                 scalars = []
                 for grp in padded:
                     rs = self._rlc_scalars(k)
-                    scalars.append(
-                        [r if idx is not None else 0 for r, idx in zip(rs, grp)]
+                    scalars.extend(
+                        r if idx is not None else 0 for r, idx in zip(rs, grp)
                     )
-                rbits = np.stack(
-                    [curve.scalars_to_bits(row, self._rlc_bits()) for row in scalars]
-                )
+            rbits = curve.scalars_to_bits(
+                scalars, self._rlc_bits()
+            ).reshape(g, k, -1)
 
-                args = build_group_arrays(padded, g, k)
-                placed = self._place(tuple(args) + (jnp.asarray(rbits),))
-            # two RLC_BITS-wide w2 ladders per lane (share + key combine);
-            # the 64-bit coefficients stay on the classic path — GLV
-            # decomposition has nothing to split below 2^127
-            self._count_ladder(
-                rbits, sum(len(grp) for grp in pending), glv=False,
-                ladders_per_lane=2,
+            args = build_group_arrays(padded, g, k)
+            placed = self._place(tuple(args) + (jnp.asarray(rbits),))
+        # two RLC_BITS-wide w2 ladders per lane (share + key combine);
+        # the 64-bit coefficients stay on the classic path — GLV
+        # decomposition has nothing to split below 2^127
+        n_items = sum(len(grp) for grp in pending)
+        self._count_ladder(rbits, n_items, glv=False, ladders_per_lane=2)
+        self.counters.rlc_groups += len(pending)
+        return placed, n_items
+
+    def _rlc_process_round(self, pending, f, items, results, direct_quad):
+        """Apply one round's group verdicts: passing groups write True,
+        small contaminated groups drop to async exact per-item checks,
+        larger ones bisect into the next round."""
+        next_pending: List[List[int]] = []
+        new_leaves: List[int] = []
+        if hostpipe_enabled():
+            group_ok = pairing.is_one_host_batch(f, len(pending))
+        else:
+            group_ok = [
+                pairing.is_one_host(f, gi) for gi in range(len(pending))
+            ]
+        for gi, grp in enumerate(pending):
+            if group_ok[gi]:
+                for idx in grp:
+                    results[idx] = True
+            elif len(grp) < 2 * self.rlc_min_group:
+                new_leaves.extend(grp)
+            else:
+                mid = len(grp) // 2
+                next_pending.append(grp[:mid])
+                next_pending.append(grp[mid:])
+        if new_leaves:
+            self._check_batch_async(
+                [direct_quad(items[idx]) for idx in new_leaves],
+                lambda j, ok, leaves=tuple(new_leaves): results.__setitem__(
+                    leaves[j], ok
+                ),
             )
-            self.counters.rlc_groups += len(pending)
-            self.counters.device_dispatches += 1
-            f = self._dispatch_fetch(
-                jitted, placed, kind=kind,
-                items=sum(len(grp) for grp in pending),
-            )
-            next_pending: List[List[int]] = []
-            new_leaves: List[int] = []
-            for gi, grp in enumerate(pending):
-                if pairing.is_one_host(f, gi):
-                    for idx in grp:
-                        results[idx] = True
-                elif len(grp) < 2 * self.rlc_min_group:
-                    new_leaves.extend(grp)
-                else:
-                    mid = len(grp) // 2
-                    next_pending.append(grp[:mid])
-                    next_pending.append(grp[mid:])
-            if new_leaves:
-                self._check_batch_async(
-                    [direct_quad(items[idx]) for idx in new_leaves],
-                    lambda j, ok, leaves=tuple(new_leaves): results.__setitem__(
-                        leaves[j], ok
-                    ),
-                )
-            pending = next_pending
-        self._pipe.flush()
+        return next_pending
 
     # -- batched verification ------------------------------------------------
+
+    def _finish_verify(self, results, cont, deferred):
+        """Shared tail of the verify entry points: sync mode flushes and
+        returns the booleans; deferred mode returns a resolver that
+        flushes (materializing the deferred first-round check and any
+        async leaf checks), resumes bisection if needed, and returns the
+        same booleans the sync path would."""
+        if not deferred:
+            self._pipe.flush()
+            return [bool(r) for r in results]
+
+        def resolve():
+            self._pipe.flush()
+            if cont is not None:
+                cont()
+            return [bool(r) for r in results]
+
+        return resolve
 
     def verify_sig_shares(
         self, items: Sequence[Tuple[PublicKeyShare, bytes, SignatureShare]]
     ) -> List[bool]:
+        return self._verify_sig_shares_impl(items, deferred=False)
+
+    def verify_sig_shares_deferred(
+        self, items: Sequence[Tuple[PublicKeyShare, bytes, SignatureShare]]
+    ):
+        """Submit the batch now (direct checks + first RLC round behind
+        the in-flight queue); the returned resolver yields the same
+        booleans ``verify_sig_shares`` would, with identical dispatch
+        counts — the engine assembles the next round's work in between."""
+        return self._verify_sig_shares_impl(items, deferred=True)
+
+    def _verify_sig_shares_impl(self, items, deferred: bool):
         g1 = self.group.g1()
 
         def direct(item):
@@ -569,19 +752,20 @@ class TpuBackend(CryptoBackend):
         def build(padded, g, k):
             flat = [i for grp in padded for i in grp]
             cache = self._stage
-            # Jacobian form (Z=1) for the ladder lanes.
+            # Jacobian form (Z=1) for the ladder lanes; per-receiver
+            # replication gathers from the distinct staged rows.
             S_jac = self._reshape_groups(
-                curve.g2_to_device(
+                self._to_device_gather(
                     [items[i][2].el if i is not None else None for i in flat],
-                    cache=cache,
+                    curve.g2_to_device,
                 ),
                 g,
                 k,
             )
             PK_jac = self._reshape_groups(
-                curve.g1_to_device(
+                self._to_device_gather(
                     [items[i][0].el if i is not None else None for i in flat],
-                    cache=cache,
+                    curve.g1_to_device,
                 ),
                 g,
                 k,
@@ -602,11 +786,11 @@ class TpuBackend(CryptoBackend):
         def jitted(S_jac, PK_jac, neg_g1, H, rbits):
             return _jitted_rlc_sig()(S_jac, PK_jac, rbits, neg_g1, H)
 
-        self._grouped_rlc(
-            rlc_groups, items, build, jitted, results, direct, kind="rlc_sig"
+        cont = self._grouped_rlc(
+            rlc_groups, items, build, jitted, results, direct,
+            kind="rlc_sig", deferred=deferred,
         )
-        self._pipe.flush()
-        return [bool(r) for r in results]
+        return self._finish_verify(results, cont, deferred)
 
     def verify_signatures(
         self, items: Sequence[Tuple[Any, bytes, Signature]]
@@ -621,6 +805,16 @@ class TpuBackend(CryptoBackend):
     def verify_dec_shares(
         self, items: Sequence[Tuple[PublicKeyShare, Ciphertext, DecryptionShare]]
     ) -> List[bool]:
+        return self._verify_dec_shares_impl(items, deferred=False)
+
+    def verify_dec_shares_deferred(
+        self, items: Sequence[Tuple[PublicKeyShare, Ciphertext, DecryptionShare]]
+    ):
+        """Deferred twin of ``verify_dec_shares`` (contract as for
+        ``verify_sig_shares_deferred``)."""
+        return self._verify_dec_shares_impl(items, deferred=True)
+
+    def _verify_dec_shares_impl(self, items, deferred: bool):
         def direct(item):
             pk, ct, share = item
             h = self._hash_g2(self.group.g1_to_bytes(ct.u) + ct.v)
@@ -651,17 +845,17 @@ class TpuBackend(CryptoBackend):
             flat = [i for grp in padded for i in grp]
             cache = self._stage
             D_jac = self._reshape_groups(
-                curve.g1_to_device(
+                self._to_device_gather(
                     [items[i][2].el if i is not None else None for i in flat],
-                    cache=cache,
+                    curve.g1_to_device,
                 ),
                 g,
                 k,
             )
             PK_jac = self._reshape_groups(
-                curve.g1_to_device(
+                self._to_device_gather(
                     [items[i][0].el if i is not None else None for i in flat],
-                    cache=cache,
+                    curve.g1_to_device,
                 ),
                 g,
                 k,
@@ -684,20 +878,33 @@ class TpuBackend(CryptoBackend):
         def jitted(D_jac, PK_jac, H, W, rbits):
             return _jitted_rlc_dec()(D_jac, PK_jac, rbits, H, W)
 
-        self._grouped_rlc(
-            rlc_groups, items, build, jitted, results, direct, kind="rlc_dec"
+        cont = self._grouped_rlc(
+            rlc_groups, items, build, jitted, results, direct,
+            kind="rlc_dec", deferred=deferred,
         )
-        self._pipe.flush()
-        return [bool(r) for r in results]
+        return self._finish_verify(results, cont, deferred)
 
     def verify_ciphertexts(self, items: Sequence[Ciphertext]) -> List[bool]:
         self.counters.ciphertexts_verified += len(items)
+        return self._check_batch(self._ct_quads(items))
+
+    def verify_ciphertexts_deferred(self, items: Sequence[Ciphertext]):
+        """Deferred twin of ``verify_ciphertexts``: the pairing chunks
+        execute behind the in-flight queue while the engine assembles the
+        decrypt-share round; the resolver flushes and returns the same
+        booleans with identical dispatch counts."""
+        self.counters.ciphertexts_verified += len(items)
+        results: List[Optional[bool]] = [None] * len(items)
+        self._check_batch_async(self._ct_quads(items), results.__setitem__)
+        return self._finish_verify(results, None, deferred=True)
+
+    def _ct_quads(self, items: Sequence[Ciphertext]):
         g1 = self.group.g1()
         quads = []
         for ct in items:
             h = self._hash_g2(self.group.g1_to_bytes(ct.u) + ct.v)
             quads.append((g1, ct.w, ct.u, h))
-        return self._check_batch(quads)
+        return quads
 
     # -- combination ---------------------------------------------------------
 
@@ -897,7 +1104,7 @@ class TpuBackend(CryptoBackend):
                 bits = np.concatenate([bits, np.repeat(bits[:1], b - n, axis=0)])
                 negs = np.concatenate([negs, np.repeat(negs[:1], b - n, axis=0)])
                 pts = pts + [pts[0]] * (b - n)
-            P = to_device(pts, cache=self._stage)
+            P = self._to_device_gather(pts, to_device)
             placed = self._place((P, jnp.asarray(bits), jnp.asarray(negs)))
         self._count_ladder(bits, n, glv=bits.ndim == 3)
         self.counters.device_dispatches += 1
@@ -989,6 +1196,25 @@ class TpuBackend(CryptoBackend):
                     out[i] = pk_set.combine_signatures(items[i][0])
         return out  # type: ignore[return-value]
 
+    def _combine_prep(self, idxs: Tuple[int, ...], prep):
+        """Memoized (bits, negs) ladder form of the Lagrange coefficients
+        over share indices ``idxs`` (0-based) — a pure function of the
+        indices and the GLV arm.  ``HBBFT_TPU_NO_HOSTPIPE=1`` bypasses
+        the memo so the kill-switch arm measures the legacy per-item
+        decomposition cost (and bills the legacy GLV counters)."""
+        if not hostpipe_enabled():
+            lam = lagrange_coeffs_at_zero([i + 1 for i in idxs])
+            return self._prep_scalars(prep, lam)
+        key = (prep, idxs, curve.glv_enabled())
+        hit = self._prep_memo.get(key)
+        if hit is None:
+            lam = lagrange_coeffs_at_zero([i + 1 for i in idxs])
+            hit = self._prep_scalars(prep, lam)
+            if len(self._prep_memo) >= 4096:
+                self._prep_memo.clear()
+            self._prep_memo[key] = hit
+        return hit
+
     def _lane_capped_step(self, k: int) -> int:
         """Items per combine chunk: lane-capped (one oversized graph OOMs
         HBM — see device_lane_cap), rounded down to a power of two so
@@ -1028,16 +1254,17 @@ class TpuBackend(CryptoBackend):
             negs_rows = []
             for shares in share_dicts:
                 srt = sorted(shares.items())
-                lam = lagrange_coeffs_at_zero([i + 1 for i, _ in srt])
                 flat_pts.extend(s.el for _, s in srt)
-                row_bits, row_negs = self._prep_scalars(prep, lam)
+                row_bits, row_negs = self._combine_prep(
+                    tuple(i for i, _ in srt), prep
+                )
                 bits_rows.append(row_bits)
                 negs_rows.append(row_negs)
             pad = b - len(share_dicts)
             flat_pts.extend(flat_pts[:k] * pad)
             bits_rows.extend([bits_rows[0]] * pad)
             negs_rows.extend([negs_rows[0]] * pad)
-            P = to_device(flat_pts, cache=self._stage)
+            P = self._to_device_gather(flat_pts, to_device)
             P = jax.tree_util.tree_map(
                 lambda c: jnp.reshape(c, (b, k) + c.shape[1:]), P
             )

@@ -808,38 +808,44 @@ def glv_table_field_muls(bits: np.ndarray) -> int:
 # ---------------------------------------------------------------------------
 
 
-def g1_to_device(points: Sequence[Optional[Tuple[int, int]]], cache=None):
+def g1_to_device(
+    points: Sequence[Optional[Tuple[int, int]]], cache=None, gather=None
+):
     """Affine G1 points (golden-ref (x, y) ints or None) → batched Jacobian.
 
     ``cache`` (an ops/staging.StagingCache) serves repeated coordinate
     values from the cross-call limb-row cache instead of re-running the
-    bigint conversion per dispatch."""
+    bigint conversion per dispatch.  ``gather`` (numpy int indices)
+    expands converted DISTINCT rows to full lane width host-side (see
+    pairing.g1_affine_to_device)."""
     conv = cache.rows if cache is not None else fq.from_ints
-    xs = conv([(p[0] if p else 0) for p in points])
-    ys = conv([(p[1] if p else 1) for p in points])
-    inf = np.array([p is None for p in points])
+    g = (lambda a: a[gather]) if gather is not None else (lambda a: a)
+    xs = g(conv([(p[0] if p else 0) for p in points]))
+    ys = g(conv([(p[1] if p else 1) for p in points]))
+    inf = g(np.array([p is None for p in points]))
     zs = np.where(
         inf[:, None], np.asarray(fq.ZERO), np.asarray(fq.ONE)
     ).astype(np.asarray(fq.ONE).dtype)
     return (jnp.asarray(xs), jnp.asarray(ys), jnp.asarray(zs), jnp.asarray(inf))
 
 
-def g2_to_device(points, cache=None):
+def g2_to_device(points, cache=None, gather=None):
     """Affine G2 points (((x0,x1),(y0,y1)) or None) → batched Jacobian."""
     conv = cache.rows if cache is not None else fq.from_ints
+    g = (lambda a: a[gather]) if gather is not None else (lambda a: a)
     X = (
-        conv([(p[0][0] if p else 0) for p in points]),
-        conv([(p[0][1] if p else 0) for p in points]),
+        g(conv([(p[0][0] if p else 0) for p in points])),
+        g(conv([(p[0][1] if p else 0) for p in points])),
     )
     Y = (
-        conv([(p[1][0] if p else 1) for p in points]),
-        conv([(p[1][1] if p else 0) for p in points]),
+        g(conv([(p[1][0] if p else 1) for p in points])),
+        g(conv([(p[1][1] if p else 0) for p in points])),
     )
     Z = (
-        conv([(1 if p is not None else 0) for p in points]),
-        conv([0 for _ in points]),
+        g(conv([(1 if p is not None else 0) for p in points])),
+        g(conv([0 for _ in points])),
     )
-    inf = np.array([p is None for p in points])
+    inf = g(np.array([p is None for p in points]))
     return (
         tuple(jnp.asarray(c) for c in X),
         tuple(jnp.asarray(c) for c in Y),

@@ -58,32 +58,42 @@ _X_BITS = [int(b) for b in bin(BLS_X)[3:]]
 # ---------------------------------------------------------------------------
 
 
-def g1_affine_to_device(points: Sequence[Optional[Tuple[int, int]]], cache=None):
+def g1_affine_to_device(
+    points: Sequence[Optional[Tuple[int, int]]], cache=None, gather=None
+):
     """Affine G1 ints (or None) → (x, y, inf) limb batch.
 
     ``cache`` (an ops/staging.StagingCache) replaces the per-call limb
     conversion with a cross-call value-keyed row lookup — repeated key
     material (public key shares, generators, H2 points) is converted
-    once per era instead of once per dispatch."""
+    once per era instead of once per dispatch.
+
+    ``gather`` (a numpy int index array) expands the converted DISTINCT
+    rows to the full lane width host-side — numpy fancy indexing before
+    ``jnp.asarray``, so replicated lanes never pay per-lane conversion
+    NOR an unjitted device gather (whose per-shape compiles would dwarf
+    the saving)."""
     conv = cache.rows if cache is not None else fq.from_ints
-    xs = conv([(p[0] if p else 0) for p in points])
-    ys = conv([(p[1] if p else 1) for p in points])
-    inf = np.array([p is None for p in points])
+    g = (lambda a: a[gather]) if gather is not None else (lambda a: a)
+    xs = g(conv([(p[0] if p else 0) for p in points]))
+    ys = g(conv([(p[1] if p else 1) for p in points]))
+    inf = g(np.array([p is None for p in points]))
     return (jnp.asarray(xs), jnp.asarray(ys), jnp.asarray(inf))
 
 
-def g2_affine_to_device(points, cache=None):
+def g2_affine_to_device(points, cache=None, gather=None):
     """Affine G2 tuples (or None) → (x fq2, y fq2, inf) batch."""
     conv = cache.rows if cache is not None else fq.from_ints
+    g = (lambda a: a[gather]) if gather is not None else (lambda a: a)
     X = (
-        conv([(p[0][0] if p else 0) for p in points]),
-        conv([(p[0][1] if p else 0) for p in points]),
+        g(conv([(p[0][0] if p else 0) for p in points])),
+        g(conv([(p[0][1] if p else 0) for p in points])),
     )
     Y = (
-        conv([(p[1][0] if p else 1) for p in points]),
-        conv([(p[1][1] if p else 0) for p in points]),
+        g(conv([(p[1][0] if p else 1) for p in points])),
+        g(conv([(p[1][1] if p else 0) for p in points])),
     )
-    inf = np.array([p is None for p in points])
+    inf = g(np.array([p is None for p in points]))
     return (
         tuple(jnp.asarray(c) for c in X),
         tuple(jnp.asarray(c) for c in Y),
@@ -442,6 +452,15 @@ def is_one_host(f, idx=None) -> bool:
     return tower.fq12_to_ints(f, idx) == FQ12_ONE
 
 
+def is_one_host_batch(f, n: int) -> list:
+    """Exact f == 1 for the first ``n`` lanes in one vectorized readback
+    (tower.fq12_to_ints_batch) — same booleans as ``is_one_host(f, i)``
+    per lane at a fraction of the per-item CRT cost."""
+    from hbbft_tpu.crypto.bls381 import FQ12_ONE
+
+    return [v == FQ12_ONE for v in tower.fq12_to_ints_batch(f, n)]
+
+
 def product_check(pairs) -> np.ndarray:
     """Per-item boolean: Π_k e(P_k, Q_k) == 1 (ONE shared final exp).
 
@@ -451,4 +470,4 @@ def product_check(pairs) -> np.ndarray:
     """
     f = final_exponentiation_fast(miller_product(pairs))
     n = np.asarray(f[0][0][0]).shape[0]
-    return np.array([is_one_host(f, i) for i in range(n)])
+    return np.array(is_one_host_batch(f, n))

@@ -57,6 +57,17 @@ from collections import deque
 from typing import Any, Callable, List, Optional
 
 
+def hostpipe_enabled() -> bool:
+    """Kill switch for the HOST half of the epoch (PR 5): the array
+    engine's vectorized assembly/scatter fast paths and its cross-round
+    deferred-verify overlap.  ``HBBFT_TPU_NO_HOSTPIPE=1`` restores the
+    legacy per-item loops and strictly ordered verification — outputs
+    are bit-identical and ``device_dispatches`` unchanged either way
+    (asserted in tests/test_host_buckets.py).  Re-read per epoch so
+    in-process A/Bs take effect immediately."""
+    return not os.environ.get("HBBFT_TPU_NO_HOSTPIPE")
+
+
 def pipeline_depth() -> int:
     """Max in-flight dispatches.  Re-read per submit so in-process A/Bs
     (``HBBFT_TPU_NO_PIPELINE=1`` vs. default) take effect immediately."""
@@ -218,6 +229,9 @@ class DispatchPipeline:
         p._raw = None  # release the device buffer reference
         c = self._counters
         if c is not None:
+            # host-bucket attribution (obs/hostbuckets.py): the fetch
+            # itself is device WAIT, not host work — regions subtract it
+            c.fetch_blocked_seconds += t1 - t_req
             dt = t1 - p.t0
             c.device_seconds += dt
             if p.kind:

@@ -61,6 +61,35 @@ class Counters:
     host_assembly_seconds: float = 0.0
     overlap_seconds: float = 0.0
     pipelined_dispatches: int = 0
+    # host-side attribution (PR 5: the north-star epoch's 55% "host:
+    # everything else" bucket, itemized).  host_seconds is the total host
+    # wall inside engine epochs/era changes EXCLUDING time blocked in
+    # device fetches (fetch_blocked_seconds, billed by the pipeline's
+    # resolve seam) — i.e. the time the host thread actually spent doing
+    # host work.  The host_bucket_* fields are an EXCLUSIVE partition of
+    # host_seconds (obs/hostbuckets.py region stack: each region bills
+    # its own wall minus child regions minus fetch-blocked stretches), so
+    # they sum to host_seconds by construction:
+    #   encode    — canonical encode/decode + ciphertext (de)serialization
+    #   rs_merkle — RS encode/reconstruct, Merkle commits, proof hashing
+    #   assemble  — batched-call item-list construction (rounds 7-8 etc.)
+    #   scatter   — flat dispatch results → per-(proposer, sender) state
+    #   staging   — limb packing / scalars_to_bits / point conversion
+    #               (the _host_assembly blocks; == host_assembly_seconds
+    #               minus its own fetch-blocked stretches)
+    #   dispatch  — backend batch-call host glue outside staging (group
+    #               bookkeeping, delivery callbacks, host golden paths)
+    #   other     — everything not under a named region (the residual the
+    #               <10%-unattributed acceptance bar tracks)
+    host_seconds: float = 0.0
+    fetch_blocked_seconds: float = 0.0
+    host_bucket_encode: float = 0.0
+    host_bucket_rs_merkle: float = 0.0
+    host_bucket_assemble: float = 0.0
+    host_bucket_scatter: float = 0.0
+    host_bucket_staging: float = 0.0
+    host_bucket_dispatch: float = 0.0
+    host_bucket_other: float = 0.0
     # device-staging cache (ops/staging.py): distinct field values served
     # from / inserted into the limb-row cache per staging call
     stage_cache_hits: int = 0

@@ -1,0 +1,341 @@
+"""Host-side epoch pipeline (PR 5 tentpole).
+
+Covers the three layers end to end on CPU:
+
+* attribution — HostBuckets exclusive-time region accounting (nesting,
+  fetch-block subtraction), the sums-to-host_seconds invariant, the
+  traced-run ±5% soundness check and the ``trace_report --host-buckets``
+  CLI gate;
+* elimination — the vectorized fast paths (packed Merkle proofs,
+  batched canonical encode/decode, index-arithmetic assembly/scatter)
+  pinned bit-identical to the legacy loops;
+* overlap — the ``HBBFT_TPU_NO_HOSTPIPE`` A/B: identical Batches,
+  identical EpochReport counters, identical ``device_dispatches``, with
+  the deferred-verify seam exercised out of order through MockBackend's
+  simulated-async pipeline;
+* failure attribution — Byzantine-detection raises survive the deferred
+  reordering (and ``python -O``, being raises rather than asserts).
+"""
+
+import dataclasses
+import random
+
+import pytest
+
+from hbbft_tpu.crypto.backend import MockBackend
+from hbbft_tpu.engine import ArrayHoneyBadgerNet, EngineInvariantError
+from hbbft_tpu.obs import HOST_BUCKETS, HostBuckets, Tracer
+from hbbft_tpu.utils.metrics import Counters
+
+
+def _bucket_sum(counters) -> float:
+    return sum(
+        getattr(counters, f"host_bucket_{name}") for name in HOST_BUCKETS
+    )
+
+
+def _contribs(ids, seed=11, size=24):
+    rng = random.Random(seed)
+    return {i: bytes(rng.randrange(256) for _ in range(size)) for i in ids}
+
+
+# ---------------------------------------------------------------------------
+# HostBuckets unit behavior
+# ---------------------------------------------------------------------------
+
+
+def test_region_exclusive_accounting_nests():
+    """A child region's time must land in the child's bucket only; the
+    parent bills its exclusive remainder; epoch() bills the total."""
+    import time
+
+    c = Counters()
+    hb = HostBuckets(c)
+    with hb.epoch():
+        with hb.region("assemble"):
+            time.sleep(0.02)
+            with hb.region("staging"):
+                time.sleep(0.02)
+        time.sleep(0.01)  # unattributed → "other"
+    assert c.host_bucket_staging >= 0.015
+    # parent excludes the child's slice
+    assert c.host_bucket_assemble >= 0.015
+    assert c.host_bucket_assemble < c.host_bucket_staging + 0.02
+    assert c.host_bucket_other >= 0.005
+    assert c.host_seconds == pytest.approx(_bucket_sum(c), rel=1e-6)
+
+
+def test_region_subtracts_fetch_blocked_time():
+    """Time the pipeline spent blocked in a device fetch inside a region
+    is device WAIT — it must not inflate the region's host bucket (nor
+    host_seconds)."""
+    import time
+
+    c = Counters()
+    hb = HostBuckets(c)
+    with hb.epoch():
+        with hb.region("dispatch"):
+            time.sleep(0.01)
+            # what DispatchPipeline._resolve bills during a fetch
+            c.fetch_blocked_seconds += 5.0
+    assert c.host_bucket_dispatch < 1.0
+    assert c.host_seconds < 1.0
+    assert c.host_seconds == pytest.approx(_bucket_sum(c), rel=1e-6)
+
+
+def test_region_unknown_bucket_raises():
+    hb = HostBuckets(Counters())
+    with pytest.raises(AttributeError):
+        with hb.epoch(), hb.region("not-a-bucket"):
+            pass
+
+
+def test_region_outside_epoch_is_a_noop():
+    """Backend staging blocks run from bench micro-rows too; billing
+    them without an epoch frame would break the buckets-sum-to-
+    host_seconds invariant the --host-buckets gate validates."""
+    c = Counters()
+    tr = Tracer()
+    hb = HostBuckets(c, tracer_ref=lambda: tr)
+    with hb.region("staging"):
+        pass
+    assert c.host_bucket_staging == 0.0
+    assert c.host_seconds == 0.0
+    assert len(tr.events) == 0
+
+
+def test_region_emits_exclusive_span_args():
+    c = Counters()
+    tr = Tracer()
+    hb = HostBuckets(c, tracer_ref=lambda: tr)
+    with hb.epoch():
+        with hb.region("encode"):
+            pass
+    spans = [e for e in tr.events if e.get("ph") == "B"]
+    assert {e["args"]["bucket"] for e in spans} == {"encode", "other"}
+    for e in spans:
+        assert e["args"]["host"] is True
+        assert isinstance(e["args"]["exclusive_s"], float)
+
+
+# ---------------------------------------------------------------------------
+# Engine epochs: the sums-to-total invariant + traced validation
+# ---------------------------------------------------------------------------
+
+
+def _fresh_net(n=7, tracer=None, chunk=None, **kw):
+    be = MockBackend()
+    be.pipeline_chunk = chunk
+    net = ArrayHoneyBadgerNet(range(n), backend=be, seed=3, tracer=tracer, **kw)
+    if tracer is not None:
+        be.tracer = tracer
+    return net, be
+
+
+def test_epoch_buckets_sum_to_host_seconds():
+    net, be = _fresh_net(coin_rounds=1)
+    net.run_epochs(2, payload_size=32)
+    c = be.counters
+    assert c.host_seconds > 0
+    assert _bucket_sum(c) == pytest.approx(c.host_seconds, rel=1e-6)
+    # era changes are attributed the same way
+    before = c.host_seconds
+    net.era_change()
+    assert c.host_seconds > before
+    assert _bucket_sum(c) == pytest.approx(c.host_seconds, rel=1e-6)
+
+
+def test_traced_host_buckets_validate_and_cli(tmp_path):
+    """Attribution soundness (the acceptance check): on a traced CPU run
+    the host-bucket spans sum to host_seconds within ±5% and the
+    unattributed bucket stays under 10%; the CLI gate passes/fails on
+    exactly that."""
+    from tools.trace_report import (
+        check_host_buckets,
+        load_events,
+        main as tr_main,
+        validate_chrome_trace,
+    )
+
+    # a real-coin shape: with actual per-round crypto in the epoch the
+    # inter-region glue (span emission, report arithmetic) is a ~1%
+    # residue — the microsecond-scale N=7 plain epoch would put the
+    # 10% unattributed bar within clock-noise distance
+    net, be = _fresh_net(n=10, coin_rounds=1)
+    net.run_epochs(1, payload_size=64)  # warm: module imports, native .so
+    be.counters.reset()
+    tr = Tracer()
+    net.tracer = tr
+    be.tracer = tr
+    net.run_epochs(2, payload_size=64)
+    c = be.counters
+    path = str(tmp_path / "host_trace.json")
+    tr.write(path)
+    events = load_events(path)
+    assert validate_chrome_trace(events) == []
+    ok, buckets = check_host_buckets(events, c.host_seconds)
+    assert ok, (buckets, c.host_seconds)
+    assert buckets.get("other", 0.0) < 0.10 * c.host_seconds
+    assert tr_main([path, "--host-buckets", str(c.host_seconds)]) == 0
+    assert tr_main([path, "--host-buckets", str(c.host_seconds * 3)]) == 1
+
+
+# ---------------------------------------------------------------------------
+# The A/B: vectorized + overlapped vs HBBFT_TPU_NO_HOSTPIPE=1
+# ---------------------------------------------------------------------------
+
+
+def _run_arm(no_hostpipe, monkeypatch, n=7, chunk=4, **kw):
+    if no_hostpipe:
+        monkeypatch.setenv("HBBFT_TPU_NO_HOSTPIPE", "1")
+    else:
+        monkeypatch.delenv("HBBFT_TPU_NO_HOSTPIPE", raising=False)
+    net, be = _fresh_net(n=n, chunk=chunk, **kw)
+    contribs = _contribs(net.ids)
+    batches = [net.run_epoch(contribs), net.run_epochs(1, payload_size=16)[0]]
+    reports = [dataclasses.asdict(r) for r in net.reports]
+    return batches, reports, be.counters.device_dispatches
+
+
+@pytest.mark.parametrize(
+    "kw",
+    [
+        {},
+        {"dynamic": True},
+        {"coin_rounds": 1},
+        {"dedup_verifies": True},
+    ],
+    ids=["plain", "dynamic", "coin", "dedup"],
+)
+def test_hostpipe_ab_bit_identical(monkeypatch, kw):
+    """The acceptance invariant: the vectorized + cross-round-overlapped
+    epoch produces bit-identical Batches, identical EpochReport
+    counters, and identical device_dispatches vs the kill-switch arm —
+    with the deferred verifies resolving OUT OF ORDER through the mock
+    pipeline."""
+    fast = _run_arm(False, monkeypatch, **kw)
+    legacy = _run_arm(True, monkeypatch, **kw)
+    assert fast[0] == legacy[0], "host pipeline changed Batch outputs"
+    assert fast[1] == legacy[1], "host pipeline changed EpochReport"
+    assert fast[2] == legacy[2], "host pipeline changed dispatch counts"
+
+
+def test_era_change_ab_identical(monkeypatch):
+    for no_hostpipe in (False, True):
+        if no_hostpipe:
+            monkeypatch.setenv("HBBFT_TPU_NO_HOSTPIPE", "1")
+        else:
+            monkeypatch.delenv("HBBFT_TPU_NO_HOSTPIPE", raising=False)
+        net, _ = _fresh_net(n=7)
+        net.run_epochs(3, payload_size=16, churn_at=[1])
+        if no_hostpipe:
+            legacy = [b[0] for b in net.run_epochs(1, payload_size=16)]
+        else:
+            fast = [b[0] for b in net.run_epochs(1, payload_size=16)]
+    assert fast == legacy
+
+
+# ---------------------------------------------------------------------------
+# Byzantine-detection raises (the assert→raise satellite)
+# ---------------------------------------------------------------------------
+
+
+class _RejectingBackend(MockBackend):
+    """Rejects every decryption share — the engine must RAISE (not
+    silently emit a batch), in both arms, even though the fast arm
+    resolves the verification after the speculative combines."""
+
+    def verify_dec_shares(self, items):
+        super().verify_dec_shares(items)  # keep counter accounting
+        return [False] * len(items)
+
+    def verify_dec_shares_deferred(self, items):
+        out = self.verify_dec_shares(items)
+        return lambda: out
+
+
+@pytest.mark.parametrize("no_hostpipe", [False, True])
+def test_rejected_share_raises_not_asserts(monkeypatch, no_hostpipe):
+    if no_hostpipe:
+        monkeypatch.setenv("HBBFT_TPU_NO_HOSTPIPE", "1")
+    else:
+        monkeypatch.delenv("HBBFT_TPU_NO_HOSTPIPE", raising=False)
+    net = ArrayHoneyBadgerNet(range(4), backend=_RejectingBackend(), seed=1)
+    with pytest.raises(EngineInvariantError, match="decryption share"):
+        net.run_epoch(_contribs(net.ids))
+
+
+def test_engine_invariant_is_not_bare_assert():
+    """EngineInvariantError is a real exception class, not AssertionError
+    — `python -O` cannot strip these checks."""
+    assert not issubclass(EngineInvariantError, AssertionError)
+
+
+# ---------------------------------------------------------------------------
+# Vectorized primitives pinned to the object paths
+# ---------------------------------------------------------------------------
+
+
+def test_canonical_batch_roundtrips_match_scalar():
+    from hbbft_tpu.utils import canonical
+
+    objs = [
+        b"payload",
+        b"",
+        ("icontrib", b"x" * 40, [], []),
+        {"k": 1, "j": b"v"},
+        b"\x04" * 9,  # bytes that LOOK like a tag byte
+    ]
+    batch = canonical.encode_batch(objs)
+    assert batch == [canonical.encode(o) for o in objs]
+    assert canonical.decode_batch(batch) == [
+        canonical.decode(b) for b in batch
+    ]
+
+
+def test_packed_proofs_match_object_proofs():
+    import hashlib
+
+    from hbbft_tpu import native
+    from hbbft_tpu.crypto.merkle import (
+        MerkleTree,
+        PackedProofs,
+        validate_proofs,
+    )
+
+    if not native.sha256_available():
+        pytest.skip("no C toolchain")
+    rng = random.Random(9)
+    n = 6
+    trees = [
+        MerkleTree(
+            [bytes(rng.randrange(256) for _ in range(13)) for _ in range(n)]
+        )
+        for _ in range(4)
+    ]
+    packed = PackedProofs.from_trees(trees, n)
+    assert packed is not None and len(packed) == 4 * n
+    proofs = [t.proof(s) for t in trees for s in range(n)]
+    for reps in (1, 3):
+        assert packed.validate(reps=reps) == validate_proofs(
+            proofs, n, reps=reps
+        )
+    # a corrupted root must fail exactly that row
+    bad = PackedProofs(
+        packed.leaves.copy(), packed.paths.copy(),
+        packed.indices.copy(), packed.roots.copy(), n,
+    )
+    import numpy as np
+
+    bad.roots[5] = np.frombuffer(
+        hashlib.sha256(b"evil").digest(), dtype=np.uint8
+    )
+    got = bad.validate()
+    assert got[5] is False and all(got[:5]) and all(got[6:])
+
+
+def test_packed_proofs_none_without_uniform_shapes():
+    from hbbft_tpu.crypto.merkle import MerkleTree, PackedProofs
+
+    trees = [MerkleTree([b"aa", b"bb"]), MerkleTree([b"ccc", b"ddd"])]
+    assert PackedProofs.from_trees(trees, 2) is None  # leaf_len differs

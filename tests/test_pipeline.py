@@ -224,6 +224,63 @@ def test_pipelined_vs_sync_bit_identical(tpu_setup, monkeypatch):
     assert piped[5] > 0 and sync[5] == 0  # the modes actually differed
 
 
+def test_deferred_verify_matches_sync(tpu_setup):
+    """The verify_*_deferred twins (PR 5 cross-round overlap seam):
+    submit-now/resolve-later must return the same booleans as the sync
+    entry points with identical device_dispatches — on a mixed batch
+    that exercises a passing RLC group, a contaminated group's exact
+    per-leaf fallback, and the direct paths.  Shapes deliberately reuse
+    the buckets this module compiles elsewhere (RLC (4,4), product2 and
+    ladder b=4) — the suite's XLA:CPU compile budget is tight.
+    """
+    _, sks, pks, rng = tpu_setup
+    cts = [pks.encrypt(b"deferred-ab-%d" % j, rng) for j in range(2)]
+    items = []
+    for j, ct in enumerate(cts):
+        for i in range(3):
+            # item 4 (ct 1, i 1) checks against the wrong pk share: its
+            # group fails and drops to exact per-leaf checks
+            pk = pks.public_key_share((i + 1) % 3 if j == 1 and i == 1 else i)
+            items.append(
+                (pk, ct, sks.secret_key_share(i).decrypt_share_unchecked(ct))
+            )
+    doc = b"deferred-sig"
+    sig_items = [
+        (pks.public_key_share(i), doc, sks.secret_key_share(i).sign_share(doc))
+        for i in range(3)
+    ]
+    gen_items = [(sks.secret_key_share(i % 3), cts[0]) for i in range(4)]
+
+    def run(deferred):
+        be = _fresh_tpu()
+        be.device_combine_threshold = 2
+        if deferred:
+            resolve_dec = be.verify_dec_shares_deferred(items)
+            resolve_ct = be.verify_ciphertexts_deferred(cts)
+            resolve_sig = be.verify_sig_shares_deferred(sig_items)
+            # engine-style interleaving: another batched call runs while
+            # the verifies are in flight
+            gen = be.decrypt_shares_batch(gen_items)
+            out = (resolve_dec(), resolve_ct(), resolve_sig())
+        else:
+            out = (
+                be.verify_dec_shares(items),
+                be.verify_ciphertexts(cts),
+                be.verify_sig_shares(sig_items),
+            )
+            gen = be.decrypt_shares_batch(gen_items)
+        return out, [g.el for g in gen], be.counters.device_dispatches
+
+    sync_out, sync_gen, sync_disp = run(False)
+    defer_out, defer_gen, defer_disp = run(True)
+    assert defer_out == sync_out, "deferred verify changed results"
+    assert defer_gen == sync_gen
+    assert defer_disp == sync_disp, "deferred verify changed dispatch counts"
+    assert sync_out[0][4] is False and all(
+        v for i, v in enumerate(sync_out[0]) if i != 4
+    )
+
+
 def test_check_batch_chunk_boundaries(tpu_setup):
     """Pairing lane cap at n == cap and n == cap+1: every chunk verifies
     and per-item results stay in order (True/False mix)."""

@@ -100,6 +100,10 @@ done_matrix_limb_b() {
 done_glv_ab() {
   has_row "$ART/rows_after_glv_ab.json" glv_ladder_ab
 }
+done_host_ab() {
+  has_row "$ART/rows_after_host_ab.json" array_epochs_per_sec_n100 backend=TpuBackend \
+    && has_row "$ART/rows_after_host_ab_off.json" array_epochs_per_sec_n100 backend=TpuBackend
+}
 done_flips10k() {
   has_row "$ART/rows_after_flips10k.json" coin_flips_per_sec flips=10000
 }
@@ -140,6 +144,41 @@ do_glv_ab() {
   # cheap — runs early so no window death can lose it.
   HBBFT_TPU_FQ_IMPL=rns BENCH_ONLY=glv_ladder BENCH_GLV_BATCH=1024 \
     timeout 1800 python bench.py
+}
+do_host_ab() {
+  # Host-half A/B (PR 5): the N=100 shape with and without the host
+  # pipeline (vectorized assembly + cross-round overlap) AND the
+  # dispatch pipeline — the kill-switch arm is the strictly serial
+  # pre-PR host.  3 epochs per arm keeps both inside one short window;
+  # the per-bucket host split lands on each row (host_buckets field).
+  HBBFT_TPU_FQ_IMPL=rns BENCH_ONLY=array_n100 BENCH_ARRAY_BACKEND=tpu \
+    BENCH_ARRAY_EPOCHS=3 BENCH_ARRAY_CHURN=0 \
+    timeout 7200 python bench.py
+  SNAP host_ab
+  ALIVE
+  HBBFT_TPU_NO_HOSTPIPE=1 HBBFT_TPU_NO_PIPELINE=1 \
+    HBBFT_TPU_FQ_IMPL=rns BENCH_ONLY=array_n100 BENCH_ARRAY_BACKEND=tpu \
+    BENCH_ARRAY_EPOCHS=3 BENCH_ARRAY_CHURN=0 \
+    timeout 10800 python bench.py
+  cp -f BENCH_rows.json "$ART/rows_after_host_ab_off.json" 2>/dev/null || true
+  # side-by-side per-bucket host split (driver-readable in the log)
+  python - "$ART/rows_after_host_ab.json" "$ART/rows_after_host_ab_off.json" <<'PY'
+import json, sys
+def row(path):
+    for r in json.load(open(path)).get("rows", []):
+        if r.get("metric") == "array_epochs_per_sec_n100":
+            return r
+    return {}
+on, off = row(sys.argv[1]), row(sys.argv[2])
+print(f"{'bucket':>12} {'pipelined':>12} {'kill-switch':>12}")
+names = sorted(set(on.get("host_buckets", {})) | set(off.get("host_buckets", {})))
+for b in names:
+    print(f"{b:>12} {on.get('host_buckets', {}).get(b, 0):>12} "
+          f"{off.get('host_buckets', {}).get(b, 0):>12}")
+for k in ("host_seconds_per_epoch", "host_unattributed_fraction",
+          "overlap_fraction", "value"):
+    print(f"{k:>28} {on.get(k, 0):>12} {off.get(k, 0):>12}")
+PY
 }
 do_flips10k() {
   HBBFT_TPU_FQ_IMPL=rns BENCH_ONLY=coin_e2e BENCH_COIN_FLIPS=10000 \
@@ -229,7 +268,7 @@ do_n100_churn() {
     timeout 18000 python bench.py
 }
 
-STEPS="n100 matrix_rns_a matrix_limb_a matrix_rns_b matrix_limb_b glv_ab n16_churn flips10k kernel_levers driver_budget rs_ab n32_churn n64coin n100_churn"
+STEPS="n100 matrix_rns_a matrix_limb_a matrix_rns_b matrix_limb_b glv_ab host_ab n16_churn flips10k kernel_levers driver_budget rs_ab n32_churn n64coin n100_churn"
 
 for s in $STEPS; do
   if "done_$s"; then

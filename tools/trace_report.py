@@ -143,6 +143,50 @@ def check_device_seconds(
     return (abs(got - expected) <= tol * expected, got)
 
 
+def host_bucket_seconds(events: List[Dict[str, Any]]) -> Dict[str, float]:
+    """Per-bucket host seconds from the ``host=True`` region spans.
+
+    Regions NEST (dispatch ⊃ staging), so summing span durations would
+    double-count; each span instead carries its EXCLUSIVE seconds in
+    ``args.exclusive_s`` (obs/hostbuckets.py), and those reproduce the
+    ``host_bucket_*`` counter partition from the trace alone."""
+    out: Dict[str, float] = {}
+    for s in span_durations(events):
+        args = s["args"]
+        if not args.get("host"):
+            continue
+        bucket = args.get("bucket", s["name"])
+        sec = args.get("exclusive_s")
+        if not isinstance(sec, (int, float)):
+            sec = s["dur_us"] / 1e6
+        out[bucket] = out.get(bucket, 0.0) + float(sec)
+    return out
+
+
+def check_host_buckets(
+    events: List[Dict[str, Any]],
+    expected: float,
+    tol: float = 0.05,
+    max_unattributed: float = 0.10,
+) -> Tuple[bool, Dict[str, float]]:
+    """Acceptance check for the host attribution (PR 5): the traced
+    bucket partition must sum to ``expected`` (counters.host_seconds, or
+    host_seconds_per_epoch × epochs from a bench row) within ``tol``
+    relative, AND the residual ``other`` bucket must stay under
+    ``max_unattributed`` of the total — i.e. the named buckets cover
+    ≥ 1 − max_unattributed of the epoch's host time.  Returns
+    (ok, buckets)."""
+    buckets = host_bucket_seconds(events)
+    total = sum(buckets.values())
+    if expected <= 0:
+        return (total == 0.0, buckets)
+    ok = (
+        abs(total - expected) <= tol * expected
+        and buckets.get("other", 0.0) <= max_unattributed * expected
+    )
+    return ok, buckets
+
+
 def kind_table(events: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
     """Per-category totals, device dispatches split from protocol spans.
 
@@ -175,7 +219,11 @@ def kind_table(events: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
 
 
 def report(
-    path: str, device_seconds: Optional[float] = None, tol: float = 0.05
+    path: str,
+    device_seconds: Optional[float] = None,
+    tol: float = 0.05,
+    host_buckets: Optional[float] = None,
+    host_unattributed_max: float = 0.10,
 ) -> int:
     events = load_events(path)
     errors = validate_chrome_trace(events)
@@ -201,6 +249,24 @@ def report(
         print(
             f"device-seconds check: spans {got:.4f} s vs counter "
             f"{device_seconds:.4f} s (±{tol:.0%}) — {verdict}"
+        )
+        if not ok:
+            return 1
+    if host_buckets is not None:
+        ok, buckets = check_host_buckets(
+            events, host_buckets, tol, host_unattributed_max
+        )
+        total = sum(buckets.values())
+        print(f"{'host bucket':>12} {'seconds':>10} {'share':>7}")
+        for name, sec in sorted(buckets.items(), key=lambda kv: -kv[1]):
+            share = sec / host_buckets if host_buckets else 0.0
+            print(f"{name:>12} {sec:>10.4f} {share:>6.1%}")
+        verdict = "OK" if ok else "MISMATCH"
+        print(
+            f"host-buckets check: buckets {total:.4f} s vs counter "
+            f"{host_buckets:.4f} s (±{tol:.0%}), unattributed "
+            f"{buckets.get('other', 0.0):.4f} s "
+            f"(max {host_unattributed_max:.0%}) — {verdict}"
         )
         if not ok:
             return 1
@@ -285,6 +351,19 @@ def main(argv=None) -> int:
         "--device-tol", type=float, default=0.05,
         help="relative tolerance for --device-seconds (default 0.05)",
     )
+    p.add_argument(
+        "--host-buckets", type=float, default=None,
+        help="validate that the trace's host=True bucket spans sum to "
+        "this counter value (counters.host_seconds) within --device-tol "
+        "AND that the 'other' (unattributed) bucket stays under "
+        "--host-unattributed-max of it; exit 1 on mismatch — the host-"
+        "attribution acceptance check",
+    )
+    p.add_argument(
+        "--host-unattributed-max", type=float, default=0.10,
+        help="max unattributed ('other') share for --host-buckets "
+        "(default 0.10)",
+    )
     args = p.parse_args(argv)
     if args.diff:
         if len(args.paths) != 2:
@@ -292,7 +371,10 @@ def main(argv=None) -> int:
         return report_diff(args.paths[0], args.paths[1], args.tol)
     if len(args.paths) != 1:
         p.error("exactly one trace path (or --diff OLD NEW)")
-    return report(args.paths[0], args.device_seconds, args.device_tol)
+    return report(
+        args.paths[0], args.device_seconds, args.device_tol,
+        args.host_buckets, args.host_unattributed_max,
+    )
 
 
 if __name__ == "__main__":
