@@ -519,6 +519,179 @@ def bench_rlc_dec_adversarial() -> dict:
     }
 
 
+def _adv_contaminated_items(backend, gct: int, k: int, frac: float, seed: int = 5):
+    """(items, want) for a dec-share verify batch with ``frac`` of the
+    shares swapped for another sender's share of the same ciphertext
+    (well-typed, fails the pairing) — the bench_rlc_dec_adversarial
+    construction, parameterized over the contamination rate."""
+    import random
+
+    from hbbft_tpu.crypto.keys import SecretKeySet
+
+    g = backend.group
+    rng = random.Random(seed)
+    sk_set = SecretKeySet.random(g, 5, rng)
+    pk_set = sk_set.public_keys()
+    sks = [sk_set.secret_key_share(i) for i in range(k)]
+    cts = [pk_set.encrypt(b"advm-%d" % i, rng) for i in range(gct)]
+    gen = backend.decrypt_shares_batch(
+        [(sks[s], cts[ci]) for ci in range(gct) for s in range(k)]
+    )
+    items, want = [], []
+    n_items = gct * k
+    n_bad = max(1, int(frac * n_items)) if frac > 0 else 0
+    bad_at = set(rng.sample(range(n_items), n_bad)) if n_bad else set()
+    pos = 0
+    for ci in range(gct):
+        for s in range(k):
+            share = gen[pos]
+            good = pos not in bad_at
+            if not good:
+                share = gen[ci * k + (s + 1) % k]
+            items.append((pk_set.public_key_share(s), cts[ci], share))
+            want.append(good)
+            pos += 1
+    return items, want
+
+
+def bench_adv_matrix() -> dict:
+    """Contamination sweep {0, 1.6, 5, 15}% through the REAL grouped-RLC
+    verify path, adaptive group sizing vs the HBBFT_TPU_NO_ADAPTIVE_RLC=1
+    fixed arm.  The r01 adversarial row measured 2× degradation at 1.6%
+    contamination with fixed whole-document groups; this row turns that
+    cliff into a measured curve and records whether the
+    contamination-adaptive sizing (ops/backend.py _rlc_adaptive_cap,
+    blst's playbook) beats fixed sizing where it should (≥5%).
+
+    ``epochs_per_s_est`` is the derived proxy: measured verify
+    throughput / N² distinct dec-share verifies per epoch at the
+    north-star N=100 dedup shape (10 000 per epoch)."""
+    import os as _os
+
+    from hbbft_tpu.ops.backend import TpuBackend
+
+    gct = _env_int("BENCH_ADVM_GROUPS", 8)
+    k = _env_int("BENCH_ADVM_K", 32)
+    iters = _env_int("BENCH_ADVM_ITERS", 2)
+    fracs = [
+        float(x)
+        for x in os.environ.get("BENCH_ADVM_FRACS", "0,0.016,0.05,0.15").split(",")
+    ]
+    shares_per_epoch_n100 = 100 * 100
+
+    curve_rows = []
+    kill = "HBBFT_TPU_NO_ADAPTIVE_RLC"
+    saved = _os.environ.get(kill)
+    try:
+        for frac in fracs:
+            per_arm = {}
+            for arm in ("adaptive", "fixed"):
+                _os.environ[kill] = "0" if arm == "adaptive" else "1"
+                backend = TpuBackend()
+                items, want = _adv_contaminated_items(backend, gct, k, frac)
+                got = backend.verify_dec_shares(items)  # warm + train + check
+                assert got == want, f"adv_matrix attribution wrong ({arm}, {frac})"
+                lf0 = backend.counters.ladder_field_muls
+                d0 = backend.counters.device_dispatches
+                s0 = backend.counters.rlc_adaptive_splits
+                t0 = time.perf_counter()
+                for _ in range(iters):
+                    got = backend.verify_dec_shares(items)
+                dt = (time.perf_counter() - t0) / iters
+                assert got == want, f"adv_matrix attribution wrong ({arm}, {frac})"
+                tput = len(items) / dt
+                per_arm[arm] = {
+                    "shares_per_sec": round(tput, 2),
+                    "epochs_per_s_est": round(tput / shares_per_epoch_n100, 4),
+                    "ladder_field_muls": (
+                        backend.counters.ladder_field_muls - lf0
+                    ) // iters,
+                    "device_dispatches": (
+                        backend.counters.device_dispatches - d0
+                    ) // iters,
+                    # per-iteration like the two deltas above (the warm/
+                    # training pass is excluded from all three)
+                    "adaptive_splits": (
+                        backend.counters.rlc_adaptive_splits - s0
+                    ) // iters,
+                }
+            ratio = (
+                per_arm["adaptive"]["shares_per_sec"]
+                / per_arm["fixed"]["shares_per_sec"]
+                if per_arm["fixed"]["shares_per_sec"]
+                else None
+            )
+            curve_rows.append(
+                {
+                    "contamination_frac": frac,
+                    "adaptive": per_arm["adaptive"],
+                    "fixed": per_arm["fixed"],
+                    "adaptive_over_fixed": round(ratio, 3) if ratio else None,
+                }
+            )
+    finally:
+        if saved is None:
+            _os.environ.pop(kill, None)
+        else:
+            _os.environ[kill] = saved
+
+    at5 = next(
+        (r["adaptive_over_fixed"] for r in curve_rows
+         if abs(r["contamination_frac"] - 0.05) < 1e-9),
+        None,
+    )
+    return {
+        "metric": "adv_matrix",
+        # headline: adaptive-over-fixed wall ratio at the 5% point
+        "value": at5 if at5 is not None else 0.0,
+        "unit": "x (adaptive/fixed @5%)",
+        "vs_baseline": at5 if at5 is not None else 0.0,
+        "baseline": "fixed sizing",
+        "batch": gct * k,
+        "groups": gct,
+        "curve": curve_rows,
+        "adaptive_beats_fixed_at_5pct": bool(at5 and at5 > 1.0),
+    }
+
+
+def bench_scenario_matrix() -> dict:
+    """The adversary × network-schedule liveness matrix (net/scenarios.py)
+    at the fast shape (N∈{4,7}, all attacks × 2 schedules, MockBackend):
+    every cell must commit identical Batches on all honest nodes with the
+    expected fault kinds attributed.  The row's ``fault_kinds`` aggregate
+    feeds tools/trace_report.py --faults (fault-kind count drift between
+    captures)."""
+    from hbbft_tpu.net.scenarios import run_matrix
+
+    ns = [int(x) for x in os.environ.get("BENCH_SCEN_NS", "4,7").split(",")]
+    schedules = os.environ.get("BENCH_SCEN_SCHEDULES", "uniform,partition_heal")
+    t0 = time.perf_counter()
+    results = run_matrix(ns=ns, schedules=tuple(schedules.split(",")), epochs=1)
+    dt = time.perf_counter() - t0
+    n_ok = sum(1 for r in results if r.ok)
+    fault_kinds: dict = {}
+    for r in results:
+        for kind, cnt in r.fault_kinds.items():
+            fault_kinds[kind] = fault_kinds.get(kind, 0) + cnt
+    failed = [
+        {"attack": r.attack, "schedule": r.schedule, "n": r.n, "error": r.error}
+        for r in results
+        if not r.ok
+    ]
+    return {
+        "metric": "scenario_matrix",
+        "value": round(len(results) / dt, 2),
+        "unit": "cells/s",
+        "vs_baseline": 1.0,
+        "baseline": "estimated",
+        "cells": len(results),
+        "cells_ok": n_ok,
+        "all_ok": n_ok == len(results),
+        "fault_kinds": dict(sorted(fault_kinds.items())),
+        "failed_cells": failed,
+    }
+
+
 def bench_g2_sign() -> dict:
     """Batched 254-bit G2 ladders — the sign op of vmapped coin flips."""
     import random
@@ -1494,7 +1667,8 @@ _BENCH_EST_S = {
     "array_n100_tpu": 1200, "rs_encode": 120, "rs_host": 60,
     "fq_kernel": 240, "n4": 60, "n4_realcrypto": 300, "n100": 420,
     "array_n256_soak": 300, "array_n100_dedup": 120, "array_n64_coin": 240,
-    "array_n100": 300, "glv_ladder": 180,
+    "array_n100": 300, "glv_ladder": 180, "adv_matrix": 600,
+    "scenario_matrix": 60,
 }
 
 
@@ -1526,6 +1700,7 @@ def _plan_benches(only, platform: str, budget: float) -> list:
             ("g2_sign", bench_g2_sign),
             ("coin_e2e", bench_coin_e2e),
             ("rlc_dec_adversarial", bench_rlc_dec_adversarial),
+            ("adv_matrix", bench_adv_matrix),
         ]
         if arrays:
             plan.append(("array_n16_tpu", bench_array_engine_n16_tpu))
@@ -1533,6 +1708,7 @@ def _plan_benches(only, platform: str, budget: float) -> list:
                 plan.append(("array_n100_tpu", bench_array_engine_n100_tpu))
         # diagnostic A/B row — after the flagship prefix, before support
         plan.append(("glv_ladder", bench_glv_ladder))
+        plan.append(("scenario_matrix", bench_scenario_matrix))
         plan += [("rs_encode", bench_rs_encode), ("rs_host", bench_rs_host)]
         if fqk:
             plan.append(("fq_kernel", bench_fq_kernel))
@@ -1569,6 +1745,8 @@ def _plan_benches(only, platform: str, budget: float) -> list:
             ("g2_sign", bench_g2_sign),
             ("coin_e2e", bench_coin_e2e),
             ("rlc_dec_adversarial", bench_rlc_dec_adversarial),
+            ("adv_matrix", bench_adv_matrix),
+            ("scenario_matrix", bench_scenario_matrix),
             ("glv_ladder", bench_glv_ladder),
         ]
         if fqk:

@@ -1,7 +1,9 @@
 """Byzantine-input discipline: remote input faults, never raises.
 
-Scope: ``hbbft_tpu/protocols/``.  A remote peer controls every byte that
-reaches a ``handle_*(self, sender_id, ...)`` entry point.  Two contracts:
+Scope: ``hbbft_tpu/protocols/`` plus the adversary/scenario harness
+(``hbbft_tpu/net/adversary.py``, ``hbbft_tpu/net/scenarios.py``).  A
+remote peer controls every byte that reaches a
+``handle_*(self, sender_id, ...)`` entry point.  Two contracts:
 
 * **No raising on remote input.** A malformed message is *evidence*
   (``Step.from_fault`` / ``PartOutcome(fault=...)``), not an exception —
@@ -23,6 +25,15 @@ named ``handle_*`` whose parameter list includes ``sender_id`` or
 ``sender`` — matching ``ConsensusProtocol.handle_message`` and the
 SyncKeyGen ``handle_part``/``handle_ack`` family; ``handle_input`` (local
 input, trusted embedder) is deliberately out of scope.
+
+In the net/ harness scope the same discipline applies to the adversary
+hook surface (``tamper`` / ``pre_crank`` / ``on_send``): a tamper hook
+sees every message shape the protocols can emit — including shapes a
+*different* adversary already mangled — so it must pass unknown payloads
+through rather than raise (an attack harness that crashes on malformed
+state can't compose into the scenario matrix), and it must not
+dereference into ``msg.payload`` internals without an ``isinstance``
+guard (the structural analogue of the sender-membership check).
 """
 
 from __future__ import annotations
@@ -103,18 +114,27 @@ def _mentions_membership_check(node: ast.AST, sender: str) -> bool:
     return False
 
 
+#: adversary/scenario hook surface checked in the net/ scope
+_HOOK_NAMES = ("tamper", "pre_crank", "on_send")
+_NET_SCOPE = ("hbbft_tpu/net/adversary.py", "hbbft_tpu/net/scenarios.py")
+
+
 @register
 class ByzantineInputRule(Rule):
     rule_id = "byzantine-input"
-    scope = ("hbbft_tpu/protocols/",)
+    scope = ("hbbft_tpu/protocols/",) + _NET_SCOPE
 
     def check_module(self, mod: ModuleSource) -> List[Finding]:
         findings: List[Finding] = []
+        in_net_scope = mod.path in _NET_SCOPE
         for node in ast.walk(mod.tree):
             if not isinstance(node, ast.ClassDef):
                 continue
             for fn in node.body:
                 if not isinstance(fn, ast.FunctionDef):
+                    continue
+                if in_net_scope and fn.name in _HOOK_NAMES:
+                    findings.extend(self._check_hook(mod, node.name, fn))
                     continue
                 if not fn.name.startswith("handle_") or fn.name == "handle_input":
                     continue
@@ -122,6 +142,51 @@ class ByzantineInputRule(Rule):
                 if sender is None:
                     continue
                 findings.extend(self._check_handler(mod, node.name, fn, sender))
+        return findings
+
+    def _check_hook(
+        self, mod: ModuleSource, cls: str, fn: ast.FunctionDef
+    ) -> List[Finding]:
+        """Adversary-hook contract: never raise (malformed or foreign
+        message shapes pass through), and don't reach past ``.payload``
+        into message internals without an isinstance guard somewhere in
+        the hook (tamper surgery must be type-checked)."""
+        findings: List[Finding] = []
+        for sub in self._escaping_raises(fn):
+            findings.append(
+                Finding(
+                    self.rule_id,
+                    mod.path,
+                    sub.lineno,
+                    sub.col_offset,
+                    f"{cls}.{fn.name} raises inside an adversary hook; "
+                    "pass unrecognized messages through instead",
+                )
+            )
+        has_guard = any(
+            isinstance(sub, ast.Call)
+            and isinstance(sub.func, ast.Name)
+            and sub.func.id in ("isinstance", "locate_inner", "classify_inner")
+            for sub in ast.walk(fn)
+        )
+        if not has_guard:
+            for sub in ast.walk(fn):
+                if (
+                    isinstance(sub, ast.Attribute)
+                    and isinstance(sub.value, ast.Attribute)
+                    and sub.value.attr == "payload"
+                ):
+                    findings.append(
+                        Finding(
+                            self.rule_id,
+                            mod.path,
+                            sub.lineno,
+                            sub.col_offset,
+                            f"{cls}.{fn.name} dereferences .payload internals "
+                            "without an isinstance/locate_inner guard",
+                        )
+                    )
+                    break
         return findings
 
     def _check_handler(

@@ -119,7 +119,17 @@ class _SetTypeTracker(ast.NodeVisitor):
 @register
 class DeterminismRule(Rule):
     rule_id = "determinism"
-    scope = ("hbbft_tpu/protocols/", "hbbft_tpu/core/")
+    # The adversary/scenario harness is in scope (seeded-replay contract:
+    # same seed ⇒ identical fault log and batch digests, so attacks and
+    # schedules must draw entropy only from net.rng); the VirtualNet
+    # runtime itself is not (it OWNS the seeded rng and legitimately
+    # reads wall time for tracer spans).
+    scope = (
+        "hbbft_tpu/protocols/",
+        "hbbft_tpu/core/",
+        "hbbft_tpu/net/adversary.py",
+        "hbbft_tpu/net/scenarios.py",
+    )
 
     def check_module(self, mod: ModuleSource) -> List[Finding]:
         findings: List[Finding] = []

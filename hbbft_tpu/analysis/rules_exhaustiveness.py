@@ -23,16 +23,47 @@ The rule also drift-checks ``WIRE_VARIANTS`` against the codec itself:
 every registered class must appear in an ``isinstance`` test in
 ``_to_tree``, and every registered tag/kind must occur as a string
 literal in the module.
+
+The same discipline covers fault kinds: ``core/fault_log.py`` declares
+the full ``FAULT_KINDS`` registry, and this rule cross-checks it both
+ways — every ``"prefix:name"`` literal a protocol module emits must be
+registered, every registered kind must still be emitted by its protocol
+module, and every fault kind the scenario harness (net/scenarios.py)
+*expects* an attack to plant must exist in the registry.  Adding a fault
+kind (or an attack expectation) without updating the registry breaks
+lint and the scenario tests together — by design.
 """
 
 from __future__ import annotations
 
 import ast
+import re
 from typing import Dict, List, Optional, Set, Tuple
 
-from hbbft_tpu.analysis.engine import Finding, LintProject, Rule, register
+from hbbft_tpu.analysis.engine import Finding, LintProject, ModuleSource, Rule, register
 
 WIRE_PATH = "hbbft_tpu/utils/wire.py"
+FAULT_LOG_PATH = "hbbft_tpu/core/fault_log.py"
+SCENARIOS_PATH = "hbbft_tpu/net/scenarios.py"
+
+#: the canonical shape of a namespaced fault kind ("broadcast:multiple_echos")
+FAULT_KIND_RE = re.compile(r"^[a-z][a-z_0-9]*:[a-z][a-z_0-9]*$")
+
+#: fault-kind namespace prefix -> protocol module that emits it (the
+#: unused-kind direction of the cross-check is gated per prefix on its
+#: module being loaded, so --diff partial runs stay quiet)
+FAULT_PREFIX_MODULES: Dict[str, str] = {
+    "binary_agreement": "hbbft_tpu/protocols/binary_agreement.py",
+    "broadcast": "hbbft_tpu/protocols/broadcast.py",
+    "dynamic_honey_badger": "hbbft_tpu/protocols/dynamic_honey_badger.py",
+    "honey_badger": "hbbft_tpu/protocols/honey_badger.py",
+    "sbv": "hbbft_tpu/protocols/sbv_broadcast.py",
+    "sender_queue": "hbbft_tpu/protocols/sender_queue.py",
+    "subset": "hbbft_tpu/protocols/subset.py",
+    "sync_key_gen": "hbbft_tpu/protocols/sync_key_gen.py",
+    "threshold_decrypt": "hbbft_tpu/protocols/threshold_decrypt.py",
+    "threshold_sign": "hbbft_tpu/protocols/threshold_sign.py",
+}
 
 #: message class -> (module path, handler class) owning its dispatch
 HANDLERS: Dict[str, Tuple[str, str]] = {
@@ -118,13 +149,44 @@ def _isinstance_classes(tree: ast.AST, func_name: str) -> Set[str]:
     return out
 
 
+def _load_fault_kinds(tree: ast.AST) -> Optional[Dict[str, Tuple[str, ...]]]:
+    """Extract the FAULT_KINDS literal from fault_log.py's AST (no import)."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == "FAULT_KINDS":
+                    try:
+                        value = ast.literal_eval(node.value)
+                    except (ValueError, SyntaxError):
+                        return None
+                    return {
+                        prefix: tuple(names) for prefix, names in value.items()
+                    }
+    return None
+
+
+def _fault_kind_literals(mod: ModuleSource) -> Dict[str, int]:
+    """Every ``prefix:name``-shaped string constant in the module -> its
+    first line number (docstrings can't match the shape: a full kind
+    string has no spaces)."""
+    out: Dict[str, int] = {}
+    for node in ast.walk(mod.tree):
+        if (
+            isinstance(node, ast.Constant)
+            and isinstance(node.value, str)
+            and FAULT_KIND_RE.match(node.value)
+        ):
+            out.setdefault(node.value, node.lineno)
+    return out
+
+
 @register
 class HandlerExhaustivenessRule(Rule):
     rule_id = "handler-exhaustiveness"
     scope = ("hbbft_tpu/",)
 
     def check_project(self, project: LintProject) -> List[Finding]:
-        findings: List[Finding] = []
+        findings = self._check_fault_kinds(project)
         wire = project.module(WIRE_PATH)
         if wire is None:
             return findings  # partial run (--diff) without wire.py: skip
@@ -234,4 +296,95 @@ class HandlerExhaustivenessRule(Rule):
                         f"{handler_cls} dispatches {cls}:{k!r} which no wire variant delivers",
                     )
                 )
+        return findings
+
+    def _check_fault_kinds(self, project: LintProject) -> List[Finding]:
+        """FAULT_KINDS registry ↔ emitted fault-kind literals, both ways,
+        plus the scenario harness's attack expectations."""
+        findings: List[Finding] = []
+        fault_log = project.module(FAULT_LOG_PATH)
+        if fault_log is None:
+            return findings  # partial run without the registry: skip
+        registry = _load_fault_kinds(fault_log.tree)
+        if registry is None:
+            return [
+                Finding(
+                    self.rule_id,
+                    FAULT_LOG_PATH,
+                    1,
+                    0,
+                    "FAULT_KINDS registry missing or not a literal",
+                )
+            ]
+        registered: Set[str] = {
+            f"{prefix}:{name}"
+            for prefix, names in sorted(registry.items())
+            for name in names
+        }
+
+        # every emitted literal must be registered
+        emitted: Dict[str, Set[str]] = {}  # kind -> modules emitting it
+        for path in sorted(project.modules):
+            if not path.startswith("hbbft_tpu/protocols/"):
+                continue
+            mod = project.modules[path]
+            for kind, line in sorted(_fault_kind_literals(mod).items()):
+                emitted.setdefault(kind, set()).add(path)
+                if kind not in registered:
+                    findings.append(
+                        Finding(
+                            self.rule_id,
+                            path,
+                            line,
+                            0,
+                            f"fault kind {kind!r} is not registered in "
+                            "core/fault_log.FAULT_KINDS",
+                        )
+                    )
+
+        # every registered kind must still be emitted by its module
+        for prefix, names in sorted(registry.items()):
+            owner = FAULT_PREFIX_MODULES.get(prefix)
+            if owner is None:
+                findings.append(
+                    Finding(
+                        self.rule_id,
+                        FAULT_LOG_PATH,
+                        1,
+                        0,
+                        f"fault-kind prefix {prefix!r} has no owning module "
+                        "in FAULT_PREFIX_MODULES",
+                    )
+                )
+                continue
+            if project.module(owner) is None:
+                continue  # partial run without the emitter: skip
+            for name in sorted(names):
+                kind = f"{prefix}:{name}"
+                if kind not in emitted:
+                    findings.append(
+                        Finding(
+                            self.rule_id,
+                            FAULT_LOG_PATH,
+                            1,
+                            0,
+                            f"registered fault kind {kind!r} is emitted by "
+                            "no protocol module",
+                        )
+                    )
+
+        # scenario expectations must be registered kinds
+        scenarios = project.module(SCENARIOS_PATH)
+        if scenarios is not None:
+            for kind, line in sorted(_fault_kind_literals(scenarios).items()):
+                if kind not in registered:
+                    findings.append(
+                        Finding(
+                            self.rule_id,
+                            SCENARIOS_PATH,
+                            line,
+                            0,
+                            f"scenario expects unregistered fault kind {kind!r}",
+                        )
+                    )
         return findings

@@ -15,6 +15,7 @@ scheduling, adversaries, and protocol RNG needs.
 
 from __future__ import annotations
 
+import heapq
 import random
 import time
 from collections import defaultdict
@@ -30,16 +31,137 @@ from hbbft_tpu.utils.metrics import Counters, EventLog
 
 
 class CrankError(Exception):
-    """Limit exceeded or invariant broken while cranking."""
+    """Limit exceeded or invariant broken while cranking.
+
+    ``report`` (when raised by :class:`VirtualNet`) carries the
+    :func:`hbbft_tpu.obs.health.why_stalled` diagnosis taken at the
+    moment of the trip — the starved protocol instances, the active
+    adversary/scenario, and the schedule state — so a tripped limit is
+    never a bare number without a culprit."""
+
+    def __init__(self, message: str, report: Optional[Dict[str, Any]] = None):
+        super().__init__(message)
+        self.report = report
 
 
 @dataclass
 class NetMessage:
-    """An in-flight message (reference `NetMessage` §)."""
+    """An in-flight message (reference `NetMessage` §).
+
+    ``not_before`` is the earliest virtual-clock time (``VirtualNet.now``)
+    the schedule layer allows this message to be delivered; 0 means
+    immediately eligible (the default when no schedule is attached)."""
 
     sender: Any
     to: Any
     payload: Any
+    not_before: int = 0
+
+
+@dataclass(frozen=True)
+class Partition:
+    """One partition episode: while ``start <= now < end`` messages
+    crossing between different ``groups`` are held (healed at ``end``)
+    or dropped.  Nodes absent from every group share an implicit
+    "mainland" side."""
+
+    start: int
+    end: int
+    groups: Tuple[frozenset, ...]
+
+    def side(self, node) -> int:
+        for i, g in enumerate(self.groups):
+            if node in g:
+                return i
+        return -1
+
+    def crosses(self, sender, to) -> bool:
+        return self.side(sender) != self.side(to)
+
+    def isolated_sets(self) -> List[set]:
+        return [set(g) for g in self.groups]
+
+
+class NetSchedule:
+    """Crank-time network conditions, composable with any adversary.
+
+    Applied at SEND time (when a message enters the queue): each message
+    gets a delivery delay in cranks — per-link base latency plus seeded
+    jitter — may be dropped i.i.d., and, while a partition episode is
+    active, traffic crossing the partition boundary is held until the
+    heal time (``partition_mode="heal"``) or dropped
+    (``partition_mode="drop"``).  All randomness comes from ``net.rng``
+    (the run's single seeded stream), so a schedule never breaks replay
+    determinism.
+
+    ``link_latency(sender, to) -> int`` overrides the uniform base
+    ``latency`` per directed link (a WAN shape: heterogeneous RTTs).
+    """
+
+    def __init__(
+        self,
+        name: str = "custom",
+        latency: int = 0,
+        jitter: int = 0,
+        drop: float = 0.0,
+        link_latency: Optional[Callable[[Any, Any], int]] = None,
+        partitions: Sequence[Partition] = (),
+        partition_mode: str = "heal",
+    ) -> None:
+        if partition_mode not in ("heal", "drop"):
+            raise ValueError(f"bad partition_mode {partition_mode!r}")
+        self.name = name
+        self.latency = latency
+        self.jitter = jitter
+        self.drop = drop
+        self.link_latency = link_latency
+        self.partitions = tuple(partitions)
+        self.partition_mode = partition_mode
+
+    def active_partition(self, now: int) -> Optional[Partition]:
+        for p in self.partitions:
+            if p.start <= now < p.end:
+                return p
+        return None
+
+    def on_send(self, net: "VirtualNet", msg: NetMessage) -> Optional[int]:
+        """Delay (in cranks) for ``msg`` entering the queue now, or None
+        to drop it.  Must not raise on any message shape: a tampered or
+        malformed payload still gets a plain link delay."""
+        rng = net.rng
+        if self.drop and rng.random() < self.drop:
+            return None
+        delay = (
+            self.link_latency(msg.sender, msg.to)
+            if self.link_latency is not None
+            else self.latency
+        )
+        if self.jitter:
+            delay += rng.randrange(self.jitter + 1)
+        part = self.active_partition(net.now)
+        if part is not None and part.crosses(msg.sender, msg.to):
+            if self.partition_mode == "drop":
+                return None
+            delay = max(delay, part.end - net.now)
+        return delay
+
+    def describe(self, now: int) -> Dict[str, Any]:
+        """State snapshot for the why-stalled report."""
+        out: Dict[str, Any] = {"name": self.name}
+        if self.latency or self.link_latency is not None:
+            out["latency"] = "per-link" if self.link_latency else self.latency
+        if self.jitter:
+            out["jitter"] = self.jitter
+        if self.drop:
+            out["drop"] = self.drop
+        part = self.active_partition(now)
+        if part is not None:
+            out["partition"] = {
+                "isolates": [sorted(g, key=repr) for g in part.isolated_sets()],
+                "heals_at": part.end,
+                "mode": self.partition_mode,
+            }
+        return out
 
 
 @dataclass
@@ -68,6 +190,8 @@ class VirtualNet:
         scheduler: str = "random",
         event_log: Optional["EventLog"] = None,
         tracer: Optional[Tracer] = None,
+        schedule: Optional[NetSchedule] = None,
+        scenario_name: Optional[str] = None,
     ) -> None:
         self.nodes = nodes
         self.backend = backend
@@ -78,6 +202,19 @@ class VirtualNet:
         self.crank_limit = crank_limit
         self.defer_mode = defer_mode
         self.scheduler = scheduler
+        #: optional network-condition layer (latency/jitter/drop/partition);
+        #: None keeps the legacy instant-delivery behavior byte-identical
+        self.schedule = schedule
+        #: scenario label (net/scenarios.py) surfaced by why_stalled
+        self.scenario_name = scenario_name
+        #: virtual clock in cranks; advances 1 per delivery and
+        #: fast-forwards when every pending message is future-dated
+        self.now = 0
+        #: future-dated messages as a (not_before, seq, msg) min-heap;
+        #: ``queue`` only ever holds deliverable-now messages, so the
+        #: scheduler pick stays O(1) with or without a schedule
+        self._future: List[Tuple[int, int, NetMessage]] = []
+        self._future_seq = 0
         self.messages_delivered = 0
         self.dropped_messages = 0
         self.cranks = 0
@@ -134,16 +271,46 @@ class VirtualNet:
 
     # -- cranking ------------------------------------------------------------
 
+    def _crank_error(self, message: str) -> CrankError:
+        """A CrankError carrying the why-stalled diagnosis: the starved
+        instances plus the active adversary/scenario and schedule state,
+        so a tripped limit names its culprit instead of a bare number."""
+        from hbbft_tpu.obs.health import render_why_stalled, why_stalled
+
+        try:
+            report = why_stalled(self)
+            rendered = render_why_stalled(report)
+        except Exception as e:  # diagnosis must never mask the trip
+            report = {"error": repr(e)}
+            rendered = f"why-stalled report unavailable: {e!r}"
+        return CrankError(f"{message}\n{rendered}", report=report)
+
+    def _release_due(self) -> None:
+        """Move future-dated messages whose time has come into the live
+        queue (time-then-insertion order: deterministic)."""
+        fut = self._future
+        while fut and fut[0][0] <= self.now:
+            self.queue.append(heapq.heappop(fut)[2])
+
     def crank(self) -> Optional[Tuple[Any, Step]]:
         """Deliver one message.  Returns (recipient, step) or None if idle."""
+        self._release_due()
         self.adversary.pre_crank(self)
         if not self.queue:
             self._flush_work()
+            self._release_due()
+            if not self.queue and self._future:
+                # everything pending is future-dated: fast-forward the
+                # virtual clock to the earliest delivery time (latency
+                # never burns cranks; real time IS the crank count)
+                self.now = self._future[0][0]
+                self._release_due()
             if not self.queue:
                 return None
         self.cranks += 1
+        self.now += 1
         if self.crank_limit is not None and self.cranks > self.crank_limit:
-            raise CrankError(f"crank limit {self.crank_limit} exceeded")
+            raise self._crank_error(f"crank limit {self.crank_limit} exceeded")
 
         scheduler = self.adversary.scheduler_override or self.scheduler
         idx = self.rng.randrange(len(self.queue)) if scheduler == "random" else 0
@@ -156,7 +323,9 @@ class VirtualNet:
             return msg.to, Step()
         self.messages_delivered += 1
         if self.message_limit is not None and self.messages_delivered > self.message_limit:
-            raise CrankError(f"message limit {self.message_limit} exceeded")
+            raise self._crank_error(
+                f"message limit {self.message_limit} exceeded"
+            )
         tr = self.tracer
         if tr is None:
             step = node.algorithm.handle_message(msg.sender, msg.payload, rng=self.rng)
@@ -211,19 +380,21 @@ class VirtualNet:
                 return
             if self.crank() is None:
                 self._flush_work()
-                if not self.queue:
+                if not self.queue and not self._future:
                     if pred(self):
                         return
-                    raise CrankError("network quiesced before predicate held")
-        raise CrankError(f"predicate not reached in {max_cranks} cranks")
+                    raise self._crank_error(
+                        "network quiesced before predicate held"
+                    )
+        raise self._crank_error(f"predicate not reached in {max_cranks} cranks")
 
     def crank_to_quiescence(self, max_cranks: int = 1_000_000) -> None:
         for _ in range(max_cranks):
             if self.crank() is None:
                 self._flush_work()
-                if not self.queue:
+                if not self.queue and not self._future:
                     return
-        raise CrankError("not quiescent")
+        raise self._crank_error(f"not quiescent after {max_cranks} cranks")
 
     # -- step processing -----------------------------------------------------
 
@@ -253,9 +424,30 @@ class VirtualNet:
             msg = NetMessage(node.id, to, tm.message)
             if node.faulty:
                 for m in self.adversary.tamper(self, msg):
-                    self.queue.append(m)
+                    self._enqueue(m)
             else:
-                self.queue.append(msg)
+                self._enqueue(msg)
+
+    def _enqueue(self, msg: NetMessage) -> None:
+        """Queue one message through the schedule layer (latency/jitter/
+        drop/partition); adversary and schedule compose — tampered
+        traffic is scheduled exactly like honest traffic.  Future-dated
+        messages park on the time-ordered heap and enter ``queue`` only
+        once deliverable."""
+        if self.schedule is not None:
+            delay = self.schedule.on_send(self, msg)
+            if delay is None:
+                self.counters.schedule_dropped += 1
+                return
+            if delay > 0:
+                msg.not_before = self.now + delay
+                self.counters.schedule_delayed += 1
+                self._future_seq += 1
+                heapq.heappush(
+                    self._future, (msg.not_before, self._future_seq, msg)
+                )
+                return
+        self.queue.append(msg)
 
     # -- deferred crypto -----------------------------------------------------
 
@@ -313,6 +505,8 @@ class NetBuilder:
         self._crank_limit: Optional[int] = None
         self._defer_mode = "eager"
         self._scheduler = "random"
+        self._schedule: Optional[NetSchedule] = None
+        self._scenario_name: Optional[str] = None
         self._event_log: Optional[EventLog] = None
         self._tracer: Optional[Tracer] = None
         self._constructor: Optional[Callable[[NetworkInfo, CryptoBackend], Any]] = None
@@ -347,6 +541,18 @@ class NetBuilder:
     def scheduler(self, mode: str) -> "NetBuilder":
         assert mode in ("random", "first")
         self._scheduler = mode
+        return self
+
+    def schedule(self, sched: Optional[NetSchedule]) -> "NetBuilder":
+        """Attach a network-condition schedule (latency/jitter/drop/
+        partition-and-heal); None keeps instant delivery."""
+        self._schedule = sched
+        return self
+
+    def scenario(self, name: str) -> "NetBuilder":
+        """Label the run for fault diagnosis: why_stalled and CrankError
+        reports name this scenario."""
+        self._scenario_name = name
         return self
 
     def trace(self, sink: Union[EventLog, Tracer]) -> "NetBuilder":
@@ -412,4 +618,6 @@ class NetBuilder:
             scheduler=self._scheduler,
             event_log=self._event_log,
             tracer=self._tracer,
+            schedule=self._schedule,
+            scenario_name=self._scenario_name,
         )

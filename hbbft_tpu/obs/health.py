@@ -144,16 +144,76 @@ def _inspect_core(core: Any) -> Dict[str, Any]:
     return {}
 
 
+def _scenario_context(net: Any) -> Optional[Dict[str, Any]]:
+    """Attack/scenario/schedule identity of a VirtualNet-like runner —
+    names the active adversary and the network condition so a starved
+    instance reads as "partition isolates {2,3}; BA coin quorum short",
+    not as an anonymous missing quorum.  Duck-typed and total: absent
+    attributes simply contribute nothing."""
+    ctx: Dict[str, Any] = {}
+    name = getattr(net, "scenario_name", None)
+    if name:
+        ctx["scenario"] = name
+    adv = getattr(net, "adversary", None)
+    if adv is not None and type(adv).__name__ != "NullAdversary":
+        describe = getattr(adv, "describe", None)
+        ctx["adversary"] = (
+            describe() if callable(describe) else type(adv).__name__
+        )
+    sched = getattr(net, "schedule", None)
+    now = getattr(net, "now", 0)
+    if sched is not None:
+        try:
+            ctx["schedule"] = sched.describe(now)
+        except Exception:  # a report must never raise on a custom schedule
+            ctx["schedule"] = {"name": type(sched).__name__}
+        future = len(getattr(net, "_future", ()) or ())
+        if future:
+            ctx["future_dated_messages"] = future
+    return ctx or None
+
+
+def _scenario_summary(ctx: Dict[str, Any]) -> str:
+    parts = []
+    if "scenario" in ctx:
+        parts.append(f"scenario {ctx['scenario']}")
+    adv = ctx.get("adversary")
+    if adv:
+        parts.append(f"adversary {adv.get('name', adv)}" if isinstance(adv, dict) else f"adversary {adv}")
+    sched = ctx.get("schedule")
+    if isinstance(sched, dict):
+        part = sched.get("partition")
+        if part:
+            isolates = "; ".join(
+                "{" + ", ".join(map(str, g)) + "}" for g in part["isolates"]
+            )
+            parts.append(
+                f"partition isolates {isolates} until crank {part['heals_at']}"
+            )
+        else:
+            parts.append(f"schedule {sched.get('name')}")
+    if ctx.get("future_dated_messages"):
+        parts.append(f"{ctx['future_dated_messages']} messages future-dated")
+    return "; ".join(parts)
+
+
 def why_stalled(net_or_nodes: Any) -> Dict[str, Any]:
     """Build the why-stalled report for a quiesced-but-unfinished run.
 
     Accepts a :class:`~hbbft_tpu.net.virtual_net.VirtualNet`, an
     ``examples.simulation.Simulation``, or any ``{node_id: node}`` mapping
     whose values carry the protocol under ``.algorithm``/``.algo`` (or
-    are the protocol itself).
+    are the protocol itself).  When the runner carries an adversary /
+    scenario / schedule (the scenario harness), the report leads with
+    that context — a starved quorum under a live partition names the
+    partition, not just the shortfall.
     """
     nodes = getattr(net_or_nodes, "nodes", net_or_nodes)
     report: Dict[str, Any] = {"nodes": {}, "summary": []}
+    ctx = _scenario_context(net_or_nodes)
+    if ctx is not None:
+        report["scenario"] = ctx
+        report["summary"].append(_scenario_summary(ctx))
     for nid in sorted(nodes, key=repr):
         node = nodes[nid]
         algo = getattr(node, "algorithm", None)
@@ -168,11 +228,12 @@ def why_stalled(net_or_nodes: Any) -> Dict[str, Any]:
     for nid, state in report["nodes"].items():
         for p, ba in state.get("ba", {}).items():
             if ba["blocked_on"] == "coin":
+                short = ba["coin_shares_needed"] - ba["coin_shares_verified"]
                 report["summary"].append(
                     f"node {nid}: BA[{p}] blocked on coin round "
-                    f"{ba['coin_round']} "
+                    f"{ba['coin_round']} — coin quorum short {short} shares "
                     f"({ba['coin_shares_verified']}/{ba['coin_shares_needed']}"
-                    " shares verified)"
+                    " verified)"
                 )
             else:
                 report["summary"].append(

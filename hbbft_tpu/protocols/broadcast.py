@@ -63,6 +63,7 @@ class Broadcast(ConsensusProtocol):
         self.echo_sent = False
         self.ready_sent = False
         self.has_value = False  # got proposer's Value (or we are proposer)
+        self._value_proof: Optional[Proof] = None  # the Value we accepted
         self.echos: Dict[Any, Proof] = {}
         self.readys: Dict[Any, bytes] = {}
         self.output: Optional[bytes] = None
@@ -124,11 +125,18 @@ class Broadcast(ConsensusProtocol):
         if sender_id != self.proposer_id:
             return Step.from_fault(sender_id, "broadcast:value_from_non_proposer")
         if self.has_value and sender_id != self.netinfo.our_id:
+            # Second Value under exactly-once delivery is provable either
+            # way; a *different* proof is equivocation (two commitments
+            # for one instance — the EquivocatingAdversary signature),
+            # split from a plain re-send exactly like Echo/Ready.
+            if self._value_proof is not None and proof != self._value_proof:
+                return Step.from_fault(sender_id, "broadcast:conflicting_values")
             return Step.from_fault(sender_id, "broadcast:multiple_values")
         our_idx = self.netinfo.node_index(self.netinfo.our_id)
         if not self._validate_proof(proof, our_idx):
             return Step.from_fault(self.proposer_id, "broadcast:invalid_value_proof")
         self.has_value = True
+        self._value_proof = proof
         return self._send_echo(proof)
 
     def _send_echo(self, proof: Proof) -> Step:

@@ -29,6 +29,10 @@ class Counters:
     messages_delivered: int = 0
     cranks: int = 0
     faults_recorded: int = 0
+    # net-schedule layer (net/virtual_net.NetSchedule): messages dropped
+    # by loss/partition policy vs merely future-dated by latency/jitter
+    schedule_dropped: int = 0
+    schedule_delayed: int = 0
     # crypto-side: items verified per kind
     sig_shares_verified: int = 0
     dec_shares_verified: int = 0
@@ -37,6 +41,7 @@ class Counters:
     # crypto-side: how the work was done
     pairing_checks: int = 0  # pairing-equation evaluations dispatched
     rlc_groups: int = 0  # grouped (random-linear-combination) checks
+    rlc_adaptive_splits: int = 0  # batches re-partitioned by observed contamination
     sig_shares_combined: int = 0  # shares consumed by signature combines
     dec_shares_combined: int = 0  # shares consumed by decryption combines
     device_dispatches: int = 0  # jitted device calls issued

@@ -115,14 +115,22 @@ def _junit_key(nodeid: str) -> tuple:
     return (cls, parts[-1])
 
 
-def _run_module_isolated(mod: str) -> None:
+def _run_module_isolated(mods) -> None:
+    """Run the selected tests of ``mods`` (a list of module paths) in ONE
+    young subprocess.  One process for the whole heavy set: the modules
+    share a single jax import and one in-process jit cache (the grouped
+    RLC / pairing / ladder graphs overlap heavily across them), which
+    buys back minutes against the tier-1 budget compared to one process
+    per module.  Crash containment is unchanged in kind — a crash kills
+    only this attempt and is retried once — just with the heavy set as
+    the blast radius instead of one module."""
     import subprocess
     import tempfile
     import xml.etree.ElementTree as ET
 
     env = dict(os.environ)
     env["HBBFT_ISOLATED"] = "1"
-    targets = _isolated_selected.get(mod) or [mod]
+    targets = [t for m in mods for t in (_isolated_selected.get(m) or [m])]
     with tempfile.NamedTemporaryFile(suffix=".xml", delete=False) as tf:
         xml_path = tf.name
     try:
@@ -155,15 +163,17 @@ def _run_module_isolated(mod: str) -> None:
             if not crashed:
                 break
             sys.stderr.write(
-                f"\n[conftest] isolated {mod} crashed "
+                f"\n[conftest] isolated {' '.join(mods)} crashed "
                 f"(rc={proc.returncode}), attempt {attempt}/2\n"
             )
         if timed_out:
-            _isolated_results[mod] = (
-                "crashed",
-                f"isolated subprocess for {mod} exceeded 5400s (hung compile?)",
-                0.0,
-            )
+            for mod in mods:
+                _isolated_results[mod] = (
+                    "crashed",
+                    f"isolated subprocess for {mod} exceeded 5400s "
+                    "(hung compile?)",
+                    0.0,
+                )
             return
         tail = (proc.stdout + proc.stderr)[-8000:]
         try:
@@ -194,11 +204,12 @@ def _run_module_isolated(mod: str) -> None:
                     _isolated_results[key] = ("passed", "", dur)
         crashed = proc.returncode not in (0, 1, 2, 5)
         if crashed or tree is None:
-            _isolated_results[mod] = (
-                "crashed",
-                f"isolated subprocess rc={proc.returncode}\n{tail}",
-                0.0,
-            )
+            for mod in mods:
+                _isolated_results[mod] = (
+                    "crashed",
+                    f"isolated subprocess rc={proc.returncode}\n{tail}",
+                    0.0,
+                )
     finally:
         try:
             os.unlink(xml_path)
@@ -213,8 +224,15 @@ def pytest_runtest_protocol(item, nextitem):
     if os.environ.get("HBBFT_ISOLATED") or mod not in _isolate_modules():
         return None
     if mod not in _isolated_ran:
-        _isolated_ran.add(mod)
-        _run_module_isolated(mod)
+        # first isolated test reached: run the WHOLE heavy set in one
+        # subprocess (shared jax import + jit caches across modules)
+        pending = [
+            m
+            for m in _isolate_modules()
+            if m not in _isolated_ran and _isolated_selected.get(m)
+        ]
+        _isolated_ran.update(pending)
+        _run_module_isolated(pending)
 
     crash = _isolated_results.get(mod)
     res = _isolated_results.get(_junit_key(item.nodeid))

@@ -38,7 +38,8 @@ def test_budget_plan_is_flagship_first(bench):
     names = [n for n, _ in bench._plan_benches(None, "tpu", 3000.0)]
     assert names[0] == "rlc_dec"
     flag = ["rlc_dec", "share_verify", "rlc_sig", "g2_sign", "coin_e2e",
-            "rlc_dec_adversarial", "array_n16_tpu", "array_n100_tpu"]
+            "rlc_dec_adversarial", "adv_matrix", "array_n16_tpu",
+            "array_n100_tpu"]
     assert names[: len(flag)] == flag
     # every flagship row comes before every support/mock row
     assert names.index("array_n100_tpu") < names.index("rs_encode")

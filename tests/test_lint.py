@@ -681,3 +681,198 @@ def test_glv_table_order_real_module_clean():
     src = (REPO_ROOT / CURVE_PATH).read_text(encoding="utf-8")
     findings = lint_sources(_glv_rule(), {CURVE_PATH: src})
     assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# Fault-kind registry cross-check (handler-exhaustiveness family, PR 7)
+# ---------------------------------------------------------------------------
+
+from hbbft_tpu.analysis.rules_exhaustiveness import (  # noqa: E402
+    FAULT_LOG_PATH,
+    SCENARIOS_PATH,
+)
+
+_FAKE_FAULT_LOG = """\
+FAULT_KINDS = {
+    "broadcast": ("multiple_echos",),
+}
+"""
+
+_FAKE_BROADCAST = """\
+class Broadcast:
+    def _handle_echo(self, sender_id, proof):
+        return Step.from_fault(sender_id, "broadcast:multiple_echos")
+"""
+
+
+def _fault_kind_lint(sources):
+    return lint_sources(HandlerExhaustivenessRule(), sources)
+
+
+def test_fault_kinds_clean_registry_passes():
+    findings = _fault_kind_lint(
+        {
+            FAULT_LOG_PATH: _FAKE_FAULT_LOG,
+            "hbbft_tpu/protocols/broadcast.py": _FAKE_BROADCAST,
+        }
+    )
+    assert findings == []
+
+
+def test_fault_kinds_flags_unregistered_emission():
+    src = _FAKE_BROADCAST + (
+        "    def _handle_x(self, sender_id):\n"
+        '        return Step.from_fault(sender_id, "broadcast:unheard_of")\n'
+    )
+    findings = _fault_kind_lint(
+        {
+            FAULT_LOG_PATH: _FAKE_FAULT_LOG,
+            "hbbft_tpu/protocols/broadcast.py": src,
+        }
+    )
+    assert any(
+        "'broadcast:unheard_of'" in f.message and "not registered" in f.message
+        for f in findings
+    )
+
+
+def test_fault_kinds_flags_registered_but_never_emitted():
+    reg = _FAKE_FAULT_LOG.replace(
+        '("multiple_echos",)', '("multiple_echos", "ghost_kind")'
+    )
+    findings = _fault_kind_lint(
+        {
+            FAULT_LOG_PATH: reg,
+            "hbbft_tpu/protocols/broadcast.py": _FAKE_BROADCAST,
+        }
+    )
+    assert any(
+        "'broadcast:ghost_kind'" in f.message and "no protocol module" in f.message
+        for f in findings
+    )
+
+
+def test_fault_kinds_flags_unregistered_scenario_expectation():
+    scen = 'EXPECT = ("broadcast:multiple_echos", "broadcast:imaginary")\n'
+    findings = _fault_kind_lint(
+        {
+            FAULT_LOG_PATH: _FAKE_FAULT_LOG,
+            "hbbft_tpu/protocols/broadcast.py": _FAKE_BROADCAST,
+            SCENARIOS_PATH: scen,
+        }
+    )
+    assert any(
+        "scenario expects unregistered" in f.message
+        and "'broadcast:imaginary'" in f.message
+        for f in findings
+    )
+
+
+def test_fault_kinds_real_registry_matches_protocols():
+    """The checked-in FAULT_KINDS registry, the protocol modules, and the
+    scenario harness agree — the same gate test_package_lint_clean
+    enforces, pinned to its cross-file inputs."""
+    from hbbft_tpu.analysis.rules_exhaustiveness import FAULT_PREFIX_MODULES
+
+    paths = (
+        [REPO_ROOT / FAULT_LOG_PATH, REPO_ROOT / SCENARIOS_PATH, REPO_ROOT / WIRE_PATH]
+        + [REPO_ROOT / p for p in sorted(FAULT_PREFIX_MODULES.values())]
+    )
+    findings = run_lint(REPO_ROOT, paths, rules=[HandlerExhaustivenessRule()])
+    assert [f for f in findings if "fault" in f.message.lower()] == []
+
+
+# ---------------------------------------------------------------------------
+# Byzantine-input extension: adversary/scenario tamper hooks
+# ---------------------------------------------------------------------------
+
+ADV_PATH = "hbbft_tpu/net/adversary.py"
+
+
+def test_byzantine_flags_raise_in_tamper_hook():
+    findings = lint_sources(
+        ByzantineInputRule(),
+        {
+            ADV_PATH: """\
+            class BadAdversary:
+                def tamper(self, net, msg):
+                    if msg.payload is None:
+                        raise ValueError("bad payload")
+                    return [msg]
+            """
+        },
+    )
+    assert any(
+        "raises inside an adversary hook" in f.message for f in findings
+    )
+
+
+def test_byzantine_flags_unguarded_payload_dereference():
+    findings = lint_sources(
+        ByzantineInputRule(),
+        {
+            ADV_PATH: """\
+            class BadAdversary:
+                def tamper(self, net, msg):
+                    kind = msg.payload.kind
+                    return [] if kind == "echo" else [msg]
+            """
+        },
+    )
+    assert any(
+        "without an isinstance" in f.message for f in findings
+    )
+
+
+def test_byzantine_guarded_tamper_hook_passes():
+    findings = lint_sources(
+        ByzantineInputRule(),
+        {
+            ADV_PATH: """\
+            class GoodAdversary:
+                def tamper(self, net, msg):
+                    if not isinstance(msg.payload, EchoMessage):
+                        return [msg]
+                    return [] if msg.payload.kind == "echo" else [msg]
+
+                def pre_crank(self, net):
+                    if net.queue:
+                        net.queue.sort(key=len)
+            """
+        },
+    )
+    assert findings == []
+
+
+def test_byzantine_hooks_outside_net_scope_ignored():
+    findings = lint_sources(
+        ByzantineInputRule(),
+        {
+            "hbbft_tpu/protocols/_x.py": """\
+            class NotAnAdversary:
+                def tamper(self, net, msg):
+                    raise RuntimeError("protocols modules keep handler rules")
+            """
+        },
+    )
+    assert findings == []  # tamper is only a hook name in the net/ scope
+
+
+def test_determinism_covers_adversary_and_scenarios():
+    """The determinism family now guards the attack/schedule harness:
+    ambient entropy in net/adversary.py or net/scenarios.py is flagged."""
+    rule = DeterminismRule()
+    assert any("net/adversary" in s for s in rule.scope)
+    findings = lint_sources(
+        rule,
+        {
+            ADV_PATH: """\
+            import random
+
+            class Sneaky:
+                def tamper(self, net, msg):
+                    return [] if random.random() < 0.5 else [msg]
+            """
+        },
+    )
+    assert any("nondeterministic module 'random'" in f.message for f in findings)

@@ -18,6 +18,18 @@ def backend():
     return TpuBackend()
 
 
+@pytest.fixture(autouse=True)
+def _reset_adaptive_window(backend):
+    """Zero the contamination-observation window between tests: the
+    module-scoped backend otherwise carries rejection observations from
+    one test's forgeries into the next test's group sizing (the adaptive
+    feature working as designed — but these structural tests each pin a
+    specific fixed-group shape).  Tests that want a trained window set
+    it explicitly."""
+    backend._rlc_obs_items = 0.0
+    backend._rlc_obs_rejects = 0.0
+
+
 @pytest.fixture(scope="module")
 def rng():
     return random.Random(77)
@@ -134,3 +146,125 @@ def test_rlc_bisection_two_forgeries_opposite_halves(backend, keyset, rng):
     p0 = backend.counters.pairing_checks
     assert backend.verify_dec_shares(items) == want
     assert backend.counters.pairing_checks - p0 <= 8  # two leaves at most
+
+
+# ---------------------------------------------------------------------------
+# Contamination-adaptive group sizing (blst's playbook; the r01 2×-at-1.6%
+# cliff).  Fresh backends per test: the jitted group checks are process-
+# global LRU caches, so no new compiles for shapes the tests above built.
+# ---------------------------------------------------------------------------
+
+
+def test_adaptive_cap_formula(monkeypatch):
+    monkeypatch.delenv("HBBFT_TPU_NO_ADAPTIVE_RLC", raising=False)
+    b = TpuBackend.__new__(TpuBackend)  # no __init__: pure-logic surface
+    b._rlc_obs_items = 0.0
+    b._rlc_obs_rejects = 0.0
+    assert b._rlc_adaptive_cap() is None  # no observations: unlimited
+    b._rlc_obs_items, b._rlc_obs_rejects = 100.0, 0.3
+    assert b._rlc_adaptive_cap() is None  # 0.3% < rlc_adapt_min_rate
+    b._rlc_obs_rejects = 1.6
+    assert b._rlc_adaptive_cap() == 44  # k* = 0.7/c at the r01 cliff rate
+    b._rlc_obs_rejects = 5.0
+    assert b._rlc_adaptive_cap() == 14
+    b._rlc_obs_rejects = 15.0
+    assert b._rlc_adaptive_cap() == 5
+    b._rlc_obs_rejects = 50.0
+    assert b._rlc_adaptive_cap() == TpuBackend.rlc_min_group  # floor
+    monkeypatch.setenv("HBBFT_TPU_NO_ADAPTIVE_RLC", "1")
+    assert b._rlc_adaptive_cap() is None  # kill switch
+
+
+def test_adaptive_split_rebalances_short_tails(monkeypatch):
+    monkeypatch.delenv("HBBFT_TPU_NO_ADAPTIVE_RLC", raising=False)
+    from hbbft_tpu.utils.metrics import Counters
+
+    b = TpuBackend.__new__(TpuBackend)
+    b.counters = Counters()
+    b._rlc_obs_items, b._rlc_obs_rejects = 100.0, 17.5  # cap = 4
+    assert b._rlc_adaptive_cap() == 4
+    out = b._rlc_apply_cap([list(range(10)), list(range(10, 13))])
+    # 10 → 4 + 4 + tail 2 (< min group) rebalanced into the prior slice;
+    # 3 ≤ cap stays whole; indices preserved exactly
+    assert [len(g) for g in out] == [4, 6, 3]
+    assert sorted(i for g in out for i in g) == list(range(13))
+    assert b.counters.rlc_adaptive_splits == 1
+    # no observations → structure untouched (the honest-path identity)
+    b._rlc_obs_items = b._rlc_obs_rejects = 0.0
+    groups = [list(range(16))]
+    assert b._rlc_apply_cap(groups) is groups
+
+
+def test_adaptive_split_results_and_attribution_identical(keyset):
+    """With a trained contamination window the next batch runs in split
+    groups — same verdicts, same exact attribution, splits counted."""
+    sks, pks = keyset
+    doc = b"adaptive-split"
+    items = []
+    for i in range(6):
+        share = sks.secret_key_share(i).sign_share(doc)
+        items.append((pks.public_key_share(i), doc, share))
+    fresh = TpuBackend()
+    fresh._rlc_obs_items, fresh._rlc_obs_rejects = 100.0, 25.0  # cap = 3
+    assert fresh.verify_sig_shares(items) == [True] * 6
+    assert fresh.counters.rlc_adaptive_splits == 1
+    assert fresh.counters.rlc_groups == 2  # 6 → [3, 3]
+    # honest batch re-grows the window: rate decays toward zero
+    assert fresh._rlc_observed_rate() < 0.25
+
+
+def test_adaptive_honest_path_identical_to_kill_switch(keyset, monkeypatch):
+    """At zero observed contamination the adaptive arm's group structure,
+    dispatch count, and results are IDENTICAL to the fixed arm."""
+    sks, pks = keyset
+    doc = b"adaptive-honest"
+    items = []
+    for i in range(6):
+        share = sks.secret_key_share(i).sign_share(doc)
+        items.append((pks.public_key_share(i), doc, share))
+    runs = {}
+    for arm, kill in (("adaptive", "0"), ("fixed", "1")):
+        monkeypatch.setenv("HBBFT_TPU_NO_ADAPTIVE_RLC", kill)
+        b = TpuBackend()
+        out = [b.verify_sig_shares(items), b.verify_sig_shares(items)]
+        runs[arm] = (
+            out,
+            b.counters.rlc_groups,
+            b.counters.device_dispatches,
+            b.counters.rlc_adaptive_splits,
+        )
+    monkeypatch.delenv("HBBFT_TPU_NO_ADAPTIVE_RLC", raising=False)
+    assert runs["adaptive"] == runs["fixed"]
+    assert runs["adaptive"][3] == 0  # no splits on honest traffic
+
+
+@pytest.mark.slow
+def test_adaptive_beats_fixed_under_contamination(monkeypatch):
+    """At ≥5% contamination the trained adaptive arm does strictly less
+    group-ladder work and fewer dispatches than fixed whole-document
+    groups, with identical exact attribution (the deterministic core of
+    the adv_matrix bench acceptance).  The contaminated batch is the
+    bench's own construction, imported so the test and the adv_matrix
+    row can never silently measure different workloads."""
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from bench import _adv_contaminated_items
+
+    stats = {}
+    for arm, kill in (("adaptive", "0"), ("fixed", "1")):
+        monkeypatch.setenv("HBBFT_TPU_NO_ADAPTIVE_RLC", kill)
+        b = TpuBackend()
+        items, want = _adv_contaminated_items(b, gct=2, k=32, frac=0.05)
+        assert b.verify_dec_shares(items) == want  # warm + train
+        lf0 = b.counters.ladder_field_muls
+        d0 = b.counters.device_dispatches
+        assert b.verify_dec_shares(items) == want
+        stats[arm] = (
+            b.counters.ladder_field_muls - lf0,
+            b.counters.device_dispatches - d0,
+        )
+    monkeypatch.delenv("HBBFT_TPU_NO_ADAPTIVE_RLC", raising=False)
+    assert stats["adaptive"][0] < stats["fixed"][0], stats
+    assert stats["adaptive"][1] <= stats["fixed"][1], stats

@@ -229,6 +229,20 @@ do_kernel_levers() {
 do_driver_budget() {
   HBBFT_TPU_FQ_IMPL=rns BENCH_BUDGET=3000 timeout 3600 python bench.py
 }
+done_adv_matrix() {
+  has_row "$ART/rows_after_adv_matrix.json" adv_matrix
+}
+do_adv_matrix() {
+  # Contamination sweep 0/1.6/5/15% at the N=100 dec-share shape
+  # (100 ciphertext groups x 100 shares), adaptive RLC sizing vs the
+  # HBBFT_TPU_NO_ADAPTIVE_RLC=1 fixed arm — both arms run inside the one
+  # bench (the kill switch is read per batch).  Banks the on-chip
+  # contamination-vs-throughput curve the r01 2x-at-1.6% cliff row
+  # lacked; the PERF.md round-8 acceptance is adaptive>fixed at >=5%.
+  HBBFT_TPU_FQ_IMPL=rns BENCH_ONLY=adv_matrix \
+    BENCH_ADVM_GROUPS=100 BENCH_ADVM_K=100 BENCH_ADVM_ITERS=2 \
+    timeout 7200 python bench.py
+}
 done_n32_churn() {
   has_row "$ART/rows_after_n32_churn.json" array_epochs_per_sec_n100 \
     backend=TpuBackend n=32
@@ -268,7 +282,7 @@ do_n100_churn() {
     timeout 18000 python bench.py
 }
 
-STEPS="n100 matrix_rns_a matrix_limb_a matrix_rns_b matrix_limb_b glv_ab host_ab n16_churn flips10k kernel_levers driver_budget rs_ab n32_churn n64coin n100_churn"
+STEPS="n100 matrix_rns_a matrix_limb_a matrix_rns_b matrix_limb_b glv_ab host_ab adv_matrix n16_churn flips10k kernel_levers driver_budget rs_ab n32_churn n64coin n100_churn"
 
 for s in $STEPS; do
   if "done_$s"; then

@@ -14,6 +14,11 @@ Two subcommands on one small CLI:
   the new value dropped more than ``--tol`` (default 10%) below the old
   (all bench metrics are higher-is-better rates).  Exit code 1 when any
   regression is flagged, so the check can gate CI.
+* ``python tools/trace_report.py --faults OLD NEW`` — diff the
+  fault-kind counts carried by rows with a ``fault_kinds`` field
+  (scenario_matrix / adv_matrix captures): count changes print, and a
+  kind that VANISHED while its row persists (an attack that stopped
+  being detected) exits 1.
 
 The validation helpers are imported by the test suite
 (tests/test_obs_tracer.py, tests/test_trace_smoke.py) — keep them
@@ -313,6 +318,66 @@ def diff_rows(
     return out
 
 
+def _fault_rows(path: str) -> Dict[str, Dict[str, int]]:
+    """metric -> fault-kind counts for every row carrying a
+    ``fault_kinds`` field (scenario_matrix, adv_matrix captures)."""
+    with open(path) as f:
+        doc = json.load(f)
+    rows = doc["rows"] if isinstance(doc, dict) else doc
+    return {
+        r["metric"]: dict(r["fault_kinds"])
+        for r in rows
+        if isinstance(r.get("fault_kinds"), dict)
+    }
+
+
+def diff_faults(old_path: str, new_path: str) -> List[Dict[str, Any]]:
+    """Per-metric fault-kind count deltas between two BENCH_rows files.
+
+    A changed count is not automatically a regression (more epochs plant
+    more faults) but a kind that DISAPPEARS while its metric persists
+    means an attack stopped being detected — that is flagged."""
+    old, new = _fault_rows(old_path), _fault_rows(new_path)
+    out: List[Dict[str, Any]] = []
+    for metric in sorted(set(old) | set(new)):
+        o, n = old.get(metric, {}), new.get(metric, {})
+        for kind in sorted(set(o) | set(n)):
+            oc, nc = o.get(kind, 0), n.get(kind, 0)
+            if oc == nc:
+                continue
+            out.append(
+                {
+                    "metric": metric,
+                    "kind": kind,
+                    "old": oc,
+                    "new": nc,
+                    # detection loss: the kind vanished while the metric row
+                    # still exists in the new capture
+                    "lost": bool(oc and not nc and metric in new),
+                }
+            )
+    return out
+
+
+def report_faults(old_path: str, new_path: str) -> int:
+    entries = diff_faults(old_path, new_path)
+    if not entries:
+        print("fault-kind counts identical")
+        return 0
+    lost = [e for e in entries if e["lost"]]
+    for e in entries:
+        flag = "  LOST" if e["lost"] else ""
+        print(
+            f"{e['metric']:>20} {e['kind']:>45} "
+            f"{e['old']:>6} -> {e['new']:>6}{flag}"
+        )
+    print(
+        f"{len(entries)} fault-kind count change(s), "
+        f"{len(lost)} detection loss(es)"
+    )
+    return 1 if lost else 0
+
+
 def report_diff(old_path: str, new_path: str, tol: float) -> int:
     entries = diff_rows(old_path, new_path, tol)
     regressed = [e for e in entries if e["regression"]]
@@ -336,6 +401,12 @@ def main(argv=None) -> int:
     p.add_argument(
         "--diff", action="store_true",
         help="treat the two paths as BENCH_rows.json files to compare",
+    )
+    p.add_argument(
+        "--faults", action="store_true",
+        help="diff fault-kind counts between two BENCH_rows.json files "
+        "(rows carrying a fault_kinds field, e.g. scenario_matrix); "
+        "exit 1 when a previously-detected kind vanished",
     )
     p.add_argument(
         "--tol", type=float, default=0.10,
@@ -365,6 +436,13 @@ def main(argv=None) -> int:
         "(default 0.10)",
     )
     args = p.parse_args(argv)
+    if args.faults:
+        if len(args.paths) != 2:
+            p.error("--faults needs exactly two BENCH_rows.json paths")
+        rc = report_faults(args.paths[0], args.paths[1])
+        if args.diff:
+            rc = max(rc, report_diff(args.paths[0], args.paths[1], args.tol))
+        return rc
     if args.diff:
         if len(args.paths) != 2:
             p.error("--diff needs exactly two BENCH_rows.json paths")
