@@ -692,6 +692,178 @@ def bench_scenario_matrix() -> dict:
     }
 
 
+def bench_qhb_traffic() -> dict:
+    """The QueueingHoneyBadger batch-size × arrival-rate curve — the
+    traffic subsystem's bench row (hbbft_tpu/traffic/): open-loop Poisson
+    client load (Zipf population, BENCH_QHB_CLIENTS) against per-node
+    bounded mempools, QHB-style random samples driven through
+    ArrayHoneyBadgerNet lockstep epochs, per-tx commit latency tracked
+    end to end.  Each grid cell records sustained committed tx/s (wall),
+    tx/epoch, p50/p90/p99 commit latency in EPOCH units, mempool depth,
+    and admission drops; arrival rates are fractions of the nominal
+    per-epoch proposal capacity N·batch_size, so >1.0 cells measure the
+    OVERLOAD regime — the acceptance claim is that the bounded mempool
+    keeps memory flat and committed tx/s within ~10% of the saturation
+    plateau (``overload`` summary fields).  One N=100 f=33 point rides
+    along (fanout="one": each client submits to one node, bounding the
+    admission cost at the north-star shape).  ``vs_baseline`` compares
+    wall tx/s against the single-core reference committing the same
+    tx/epoch at the estimated 0.25 epochs/s (N=16 real-crypto anchor).
+
+    The batch-size knob is HoneyBadgerBFT's central throughput/latency
+    trade (CCS 2016 §5): bigger batches amortize the O(N²·λ) crypto per
+    epoch over more transactions but each epoch takes longer — this row
+    turns that trade from prose into data."""
+    import random as _random
+
+    from examples.simulation import make_backend
+    from hbbft_tpu.engine import ArrayHoneyBadgerNet
+    from hbbft_tpu.obs import Tracer
+    from hbbft_tpu.traffic import (
+        ArrayTrafficDriver,
+        OpenLoopSource,
+        PayloadSizes,
+        ZipfPopulation,
+    )
+
+    n = _env_int("BENCH_QHB_N", 16)
+    epochs = _env_int("BENCH_QHB_EPOCHS", 4)
+    clients = _env_int("BENCH_QHB_CLIENTS", 10_000)
+    batches = [
+        int(x)
+        for x in os.environ.get("BENCH_QHB_BATCHES", "16,64,256").split(",")
+    ]
+    fracs = [
+        float(x)
+        for x in os.environ.get("BENCH_QHB_RATES", "0.5,1.0,2.0").split(",")
+    ]
+    backend_name = os.environ.get("BENCH_QHB_BACKEND", "mock")
+    backend_label = backend_name  # refined to backend.name by the first cell
+
+    def cell(n_, batch_size, frac, epochs_, fanout):
+        nonlocal backend_label
+        backend = make_backend(backend_name)
+        backend_label = backend.name
+        tracer = Tracer(spans=False)
+        backend.tracer = tracer
+        net = ArrayHoneyBadgerNet(
+            range(n_), backend=backend, seed=0, dynamic=True, tracer=tracer
+        )
+        rate = frac * n_ * batch_size
+        # capacity ~2 epochs of offered load at saturation: small enough
+        # that the overload cells actually exercise the bound
+        cap = max(64, 2 * n_ * batch_size)
+        src = OpenLoopSource(
+            rate, ZipfPopulation(clients, 1.1), PayloadSizes("fixed", 32)
+        )
+        drv = ArrayTrafficDriver(
+            net, src, _random.Random(1234), batch_size=batch_size,
+            mempool_capacity=cap, fanout=fanout, tracer=tracer,
+        )
+        t0 = time.perf_counter()
+        rep = drv.run(epochs_)
+        dt = time.perf_counter() - t0
+        lat = rep["tracker"]["commit_latency"]
+        return {
+            "n": n_,
+            "batch_size": batch_size,
+            "rate_frac": frac,
+            "rate_per_epoch": round(rate, 1),
+            "epochs": epochs_,
+            "committed": rep["committed"],
+            "tx_per_epoch": rep["tx_per_epoch"],
+            "tx_per_s": round(rep["committed"] / dt, 2) if dt > 0 else 0.0,
+            "epochs_per_s": round(epochs_ / dt, 4) if dt > 0 else 0.0,
+            "latency_p50": lat.get("p50", 0.0),
+            "latency_p90": lat.get("p90", 0.0),
+            "latency_p99": lat.get("p99", 0.0),
+            "mempool_capacity": cap,
+            "mempool_peak_depth": rep["mempool_peak_depth"],
+            "dropped": rep["mempool_dropped"],
+            "backpressure_epochs": rep["backpressure_epochs"],
+            "state": rep["status"]["state"],
+            "fanout": fanout,
+        }
+
+    curve = [
+        cell(n, b, frac, epochs, "all") for b in batches for frac in fracs
+    ]
+
+    # overload acceptance summary: per batch size, committed tx/epoch at
+    # every rate above saturation vs the frac==1.0 plateau, and whether
+    # the mempool bound held (peak depth never exceeded capacity)
+    overload = []
+    for b in batches:
+        plateau = next(
+            (
+                c["tx_per_epoch"]
+                for c in curve
+                if c["batch_size"] == b and abs(c["rate_frac"] - 1.0) < 1e-9
+            ),
+            None,
+        )
+        for c in curve:
+            if c["batch_size"] != b or c["rate_frac"] <= 1.0 or not plateau:
+                continue
+            overload.append(
+                {
+                    "batch_size": b,
+                    "rate_frac": c["rate_frac"],
+                    "plateau_ratio": round(c["tx_per_epoch"] / plateau, 3),
+                    "bounded": c["mempool_peak_depth"] <= c["mempool_capacity"],
+                    "named_saturated": c["state"] == "saturated",
+                }
+            )
+
+    n100_cell = None
+    if os.environ.get("BENCH_QHB_N100", "1") == "1":
+        n100_cell = cell(
+            _env_int("BENCH_QHB_N100_N", 100),
+            _env_int("BENCH_QHB_N100_BATCH", 128),
+            1.0,
+            _env_int("BENCH_QHB_N100_EPOCHS", 2),
+            "one",
+        )
+
+    best = max(curve, key=lambda c: c["tx_per_s"])
+    baseline_tx_per_s = 0.25 * best["tx_per_epoch"]  # single-core est.
+    row = {
+        "metric": "qhb_traffic",
+        "value": best["tx_per_s"],
+        "unit": "tx/s",
+        "vs_baseline": (
+            round(best["tx_per_s"] / baseline_tx_per_s, 3)
+            if baseline_tx_per_s
+            else 0.0
+        ),
+        "baseline": "estimated",
+        "backend": backend_label,
+        "n": n,
+        "epochs": epochs,
+        "clients": clients,
+        "best_cell": {
+            "batch_size": best["batch_size"],
+            "rate_frac": best["rate_frac"],
+            "latency_p99": best["latency_p99"],
+        },
+        "curve": curve,
+        "overload": overload,
+        # null, not False, when no >1.0x cell had a plateau reference —
+        # "no data" must not read as "bound violated"
+        "overload_bounded": (
+            all(o["bounded"] for o in overload) if overload else None
+        ),
+        "overload_plateau_held": (
+            all(o["plateau_ratio"] >= 0.9 for o in overload)
+            if overload
+            else None
+        ),
+    }
+    if n100_cell is not None:
+        row["n100"] = n100_cell
+    return row
+
+
 def bench_g2_sign() -> dict:
     """Batched 254-bit G2 ladders — the sign op of vmapped coin flips."""
     import random
@@ -1668,7 +1840,7 @@ _BENCH_EST_S = {
     "fq_kernel": 240, "n4": 60, "n4_realcrypto": 300, "n100": 420,
     "array_n256_soak": 300, "array_n100_dedup": 120, "array_n64_coin": 240,
     "array_n100": 300, "glv_ladder": 180, "adv_matrix": 600,
-    "scenario_matrix": 60,
+    "scenario_matrix": 60, "qhb_traffic": 420,
 }
 
 
@@ -1709,6 +1881,8 @@ def _plan_benches(only, platform: str, budget: float) -> list:
         # diagnostic A/B row — after the flagship prefix, before support
         plan.append(("glv_ladder", bench_glv_ladder))
         plan.append(("scenario_matrix", bench_scenario_matrix))
+        # traffic curve: new measured axis, ahead of the support rows
+        plan.append(("qhb_traffic", bench_qhb_traffic))
         plan += [("rs_encode", bench_rs_encode), ("rs_host", bench_rs_host)]
         if fqk:
             plan.append(("fq_kernel", bench_fq_kernel))
@@ -1747,6 +1921,7 @@ def _plan_benches(only, platform: str, budget: float) -> list:
             ("rlc_dec_adversarial", bench_rlc_dec_adversarial),
             ("adv_matrix", bench_adv_matrix),
             ("scenario_matrix", bench_scenario_matrix),
+            ("qhb_traffic", bench_qhb_traffic),
             ("glv_ladder", bench_glv_ladder),
         ]
         if fqk:
@@ -1839,6 +2014,9 @@ def main() -> None:
             ("BENCH_ARRAY_CHURN", "0"),
             ("BENCH_FQ_LANES", "4096"),
             ("BENCH_FQ_CHAIN", "50"),
+            ("BENCH_QHB_EPOCHS", "2"),
+            ("BENCH_QHB_BATCHES", "8,32"),
+            ("BENCH_QHB_N100", "0"),
         ):
             os.environ.setdefault(var, val)
     for name, fn in _plan_benches(only, platform, budget):

@@ -34,6 +34,13 @@ through rather than raise (an attack harness that crashes on malformed
 state can't compose into the scenario matrix), and it must not
 dereference into ``msg.payload`` internals without an ``isinstance``
 guard (the structural analogue of the sender-membership check).
+
+In the traffic scope (``hbbft_tpu/traffic/``) the client-facing submit
+surface (``submit*`` methods) carries the analogous contract: a client
+controls every byte of a submitted transaction, so the method must call
+a validation helper (a ``*valid*``-named callable — the mempool's
+shape/size check) BEFORE the first ``self`` state write, and a bad
+transaction is an admission outcome, never an escaping raise.
 """
 
 from __future__ import annotations
@@ -117,16 +124,32 @@ def _mentions_membership_check(node: ast.AST, sender: str) -> bool:
 #: adversary/scenario hook surface checked in the net/ scope
 _HOOK_NAMES = ("tamper", "pre_crank", "on_send")
 _NET_SCOPE = ("hbbft_tpu/net/adversary.py", "hbbft_tpu/net/scenarios.py")
+#: client-facing admission surface checked in the traffic scope
+_TRAFFIC_SCOPE = "hbbft_tpu/traffic/"
+
+
+def _is_validation_call(node: ast.AST) -> bool:
+    """A call whose target name contains ``valid`` (``self._validate``,
+    ``default_validate``, …) — the admission-layer shape check."""
+    if not isinstance(node, ast.Call):
+        return False
+    fname = None
+    if isinstance(node.func, ast.Name):
+        fname = node.func.id
+    elif isinstance(node.func, ast.Attribute):
+        fname = node.func.attr
+    return fname is not None and "valid" in fname.lower()
 
 
 @register
 class ByzantineInputRule(Rule):
     rule_id = "byzantine-input"
-    scope = ("hbbft_tpu/protocols/",) + _NET_SCOPE
+    scope = ("hbbft_tpu/protocols/",) + _NET_SCOPE + (_TRAFFIC_SCOPE,)
 
     def check_module(self, mod: ModuleSource) -> List[Finding]:
         findings: List[Finding] = []
         in_net_scope = mod.path in _NET_SCOPE
+        in_traffic_scope = mod.path.startswith(_TRAFFIC_SCOPE)
         for node in ast.walk(mod.tree):
             if not isinstance(node, ast.ClassDef):
                 continue
@@ -136,12 +159,52 @@ class ByzantineInputRule(Rule):
                 if in_net_scope and fn.name in _HOOK_NAMES:
                     findings.extend(self._check_hook(mod, node.name, fn))
                     continue
+                if in_traffic_scope and fn.name.startswith("submit"):
+                    findings.extend(self._check_submit(mod, node.name, fn))
+                    continue
                 if not fn.name.startswith("handle_") or fn.name == "handle_input":
                     continue
                 sender = _sender_param(fn)
                 if sender is None:
                     continue
                 findings.extend(self._check_handler(mod, node.name, fn, sender))
+        return findings
+
+    def _check_submit(
+        self, mod: ModuleSource, cls: str, fn: ast.FunctionDef
+    ) -> List[Finding]:
+        """Client-facing admission contract: validate before the first
+        self-state write, and never raise on a submitted transaction."""
+        findings: List[Finding] = []
+        for sub in self._escaping_raises(fn):
+            findings.append(
+                Finding(
+                    self.rule_id,
+                    mod.path,
+                    sub.lineno,
+                    sub.col_offset,
+                    f"{cls}.{fn.name} raises on client input; return an "
+                    "admission outcome instead",
+                )
+            )
+        validated = False
+        for stmt in self._linear_statements(fn):
+            if not validated and any(
+                _is_validation_call(sub) for sub in ast.walk(stmt)
+            ):
+                validated = True
+            if _is_state_write(stmt) and not validated:
+                findings.append(
+                    Finding(
+                        self.rule_id,
+                        mod.path,
+                        stmt.lineno,
+                        stmt.col_offset,
+                        f"{cls}.{fn.name} writes state before validating "
+                        "the submitted transaction",
+                    )
+                )
+                break
         return findings
 
     def _check_hook(

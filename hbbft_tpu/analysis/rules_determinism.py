@@ -123,12 +123,18 @@ class DeterminismRule(Rule):
     # same seed ⇒ identical fault log and batch digests, so attacks and
     # schedules must draw entropy only from net.rng); the VirtualNet
     # runtime itself is not (it OWNS the seeded rng and legitimately
-    # reads wall time for tracer spans).
+    # reads wall time for tracer spans).  The traffic subsystem is in
+    # scope with the same contract: generators, mempools, and drivers
+    # draw entropy only from the injected rng and never read wall clocks
+    # (same seed ⇒ identical arrival schedule, sampled proposals,
+    # Batches, and latency histograms — wall-rate timing belongs to the
+    # CALLER, bench.py).
     scope = (
         "hbbft_tpu/protocols/",
         "hbbft_tpu/core/",
         "hbbft_tpu/net/adversary.py",
         "hbbft_tpu/net/scenarios.py",
+        "hbbft_tpu/traffic/",
     )
 
     def check_module(self, mod: ModuleSource) -> List[Finding]:

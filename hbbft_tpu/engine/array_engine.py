@@ -129,9 +129,17 @@ class ArrayHoneyBadgerNet:
     epochs/sec reflect N independent nodes.
     """
 
-    # class-level fallback: snapshots written before the tracer existed
-    # restore without the instance attribute
+    # class-level fallbacks for the environment attributes (not state —
+    # save_node drops everything in _SNAPSHOT_ENV_ATTRS and restore
+    # lands back on these defaults).  batch_listeners receive the
+    # per-node Batch map after every epoch (the traffic subsystem's
+    # delivery fan-out); contribution_source, when set, supplies
+    # run_epochs' contributions (epoch -> {node: bytes}) instead of the
+    # synthetic random payloads.
     tracer = None
+    batch_listeners: Sequence = ()
+    contribution_source = None
+    _SNAPSHOT_ENV_ATTRS = ("tracer", "batch_listeners", "contribution_source")
 
     def __init__(
         self,
@@ -181,6 +189,7 @@ class ArrayHoneyBadgerNet:
         #: dispatch spans the backend adds.  Environment, not state —
         #: checkpoint() detaches it (utils/snapshot.py contract).
         self.tracer = tracer
+        self.batch_listeners: List = []
         self.counters = Counters()
         self.reports: List[EpochReport] = []
         self.churn_reports: List[EpochReport] = []
@@ -250,7 +259,15 @@ class ArrayHoneyBadgerNet:
         # bills counters.host_seconds (wall minus device-fetch-blocked)
         # and every phase below bills its named exclusive slice
         with self.backend.buckets.epoch():
-            return self._run_epoch(contributions)
+            out = self._run_epoch(contributions)
+        # delivery fan-out (traffic subsystem et al.): listeners observe
+        # the same per-node Batch map the caller receives.  Deliberately
+        # OUTSIDE the epoch region — listener work (commit bookkeeping,
+        # mempool drains) is not engine time and must not bill the
+        # attributed host_seconds total or its unattributed-share gate.
+        for cb in self.batch_listeners:
+            cb(out)
+        return out
 
     def _run_epoch(self, contributions: Dict[Any, bytes]) -> Dict[Any, Batch]:
         n, f = self.n, self.f
@@ -893,15 +910,12 @@ class ArrayHoneyBadgerNet:
         """Whole-engine state (keys, era, epoch, RNG, reports) to canonical
         snapshot bytes — the soak configs (BASELINE 3/5 at 1k epochs) are
         resumable mid-run.  The crypto backend is environment, not state
-        (utils/snapshot.py contract) — and so is the tracer, detached for
-        the duration of the encode."""
+        (utils/snapshot.py contract) — and so are the tracer and the
+        traffic hooks (batch listeners / contribution source hold live
+        callables), dropped by save_node via ``_SNAPSHOT_ENV_ATTRS``."""
         from hbbft_tpu.utils.snapshot import save_node
 
-        tr, self.tracer = self.tracer, None
-        try:
-            return save_node(self)
-        finally:
-            self.tracer = tr
+        return save_node(self)
 
     @classmethod
     def restore(cls, data: bytes, backend: CryptoBackend) -> "ArrayHoneyBadgerNet":
@@ -924,19 +938,24 @@ class ArrayHoneyBadgerNet:
         payload_size: int = 128,
         churn_at: Optional[Sequence[int]] = None,
     ) -> List[Dict[Any, Batch]]:
-        """Run k epochs with synthetic per-node contributions; an
+        """Run k epochs with synthetic per-node contributions (or, when a
+        ``contribution_source`` is installed — the traffic subsystem's
+        sourcing hook — with whatever it supplies per epoch); an
         ``era_change()`` fires before each epoch index in ``churn_at``."""
         churn = set(churn_at or ())
         out = []
         for i in range(k):
             if i in churn:
                 self.era_change()
-            contribs = {
-                nid: self.rng.getrandbits(8 * payload_size).to_bytes(
-                    payload_size, "big"
-                )
-                for nid in self.ids
-            }
+            if self.contribution_source is not None:
+                contribs = self.contribution_source(self.epoch)
+            else:
+                contribs = {
+                    nid: self.rng.getrandbits(8 * payload_size).to_bytes(
+                        payload_size, "big"
+                    )
+                    for nid in self.ids
+                }
             out.append(self.run_epoch(contribs))
         return out
 

@@ -178,6 +178,13 @@ class Node:
 class VirtualNet:
     """N protocol instances + a message queue + a crank loop."""
 
+    #: attached traffic driver (hbbft_tpu/traffic/driver.py registers
+    #: itself here so why_stalled can name a starved/saturated source).
+    #: Environment, not state: whole-net snapshots drop it (the driver
+    #: holds live callables) and restore falls back to None.
+    traffic = None
+    _SNAPSHOT_ENV_ATTRS = ("traffic",)
+
     def __init__(
         self,
         nodes: Dict[Any, Node],

@@ -197,6 +197,43 @@ def _scenario_summary(ctx: Dict[str, Any]) -> str:
     return "; ".join(parts)
 
 
+def _traffic_context(net: Any) -> Optional[Dict[str, Any]]:
+    """Traffic-source state of a runner driven by the traffic subsystem
+    (``net.traffic`` is a driver exposing ``status()`` —
+    hbbft_tpu/traffic/driver.py).  Duck-typed and total like the
+    scenario context: a runner without traffic contributes nothing, and
+    a report must never raise on a custom driver."""
+    tr = getattr(net, "traffic", None)
+    if tr is None:
+        return None
+    status = getattr(tr, "status", None)
+    if not callable(status):
+        return None
+    try:
+        st = dict(status())
+    except Exception:
+        return None
+    return st or None
+
+
+def _traffic_summary(ctx: Dict[str, Any]) -> str:
+    state = ctx.get("state", "unknown")
+    src = ctx.get("source") or {}
+    name = src.get("source", "traffic") if isinstance(src, dict) else str(src)
+    if state == "saturated":
+        return (
+            f"traffic source {name} saturated: mempool "
+            f"{ctx.get('mempool_depth', '?')}/{ctx.get('capacity', '?')}, "
+            f"{ctx.get('dropped', 0)} dropped, {ctx.get('evicted', 0)} evicted"
+        )
+    if state == "starved":
+        return (
+            f"traffic source {name} starved: mempool empty, "
+            f"{ctx.get('committed', 0)} committed, nothing pending"
+        )
+    return f"traffic source {name} {state}"
+
+
 def why_stalled(net_or_nodes: Any) -> Dict[str, Any]:
     """Build the why-stalled report for a quiesced-but-unfinished run.
 
@@ -214,6 +251,10 @@ def why_stalled(net_or_nodes: Any) -> Dict[str, Any]:
     if ctx is not None:
         report["scenario"] = ctx
         report["summary"].append(_scenario_summary(ctx))
+    tctx = _traffic_context(net_or_nodes)
+    if tctx is not None:
+        report["traffic"] = tctx
+        report["summary"].append(_traffic_summary(tctx))
     for nid in sorted(nodes, key=repr):
         node = nodes[nid]
         algo = getattr(node, "algorithm", None)

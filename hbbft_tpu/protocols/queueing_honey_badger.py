@@ -21,7 +21,7 @@ from hbbft_tpu.crypto.backend import CryptoBackend
 from hbbft_tpu.protocols.change import Change
 from hbbft_tpu.protocols.dynamic_honey_badger import DhbBatch, DynamicHoneyBadger
 from hbbft_tpu.protocols.honey_badger import EncryptionSchedule
-from hbbft_tpu.protocols.transaction_queue import TransactionQueue
+from hbbft_tpu.protocols.transaction_queue import RemovalAccount, TransactionQueue
 
 
 class QueueingHoneyBadgerBuilder:
@@ -65,6 +65,20 @@ class QueueingHoneyBadgerBuilder:
 
 
 class QueueingHoneyBadger(ConsensusProtocol):
+    # class-level fallbacks: snapshots written before these attributes
+    # existed restore without them (utils/snapshot.py rebuilds via
+    # __new__ + setattr)
+    removal_account = RemovalAccount()
+    #: optional observer called with each freshly-sampled proposal —
+    #: the traffic subsystem's queue-dwell probe (ObjectTrafficDriver
+    #: closes the submit→sampled interval here; the array engine has an
+    #: equivalent hook in its contribution source).  Environment, not
+    #: state: snapshots drop it (a live bound method would otherwise
+    #: make every traffic-driven node unsnapshotable) and restore falls
+    #: back to the class None.
+    sample_listener = None
+    _SNAPSHOT_ENV_ATTRS = ("sample_listener",)
+
     def __init__(
         self,
         netinfo: NetworkInfo,
@@ -79,6 +93,11 @@ class QueueingHoneyBadger(ConsensusProtocol):
         self.rng = rng
         self.batch_size = batch_size
         self.queue = queue if queue is not None else TransactionQueue()
+        #: cumulative committed-batch removal accounting: ``removed`` txs
+        #: were in our queue, ``absent`` committed from other proposers'
+        #: samples without ever being submitted here (the traffic
+        #: tracker's committed-elsewhere signal)
+        self.removal_account = RemovalAccount()
         self.dhb = DynamicHoneyBadger(
             netinfo,
             backend,
@@ -145,7 +164,8 @@ class QueueingHoneyBadger(ConsensusProtocol):
         # lint: allow[determinism] queue removals commute; order irrelevant
         for contributions in batch.contributions.values():
             if isinstance(contributions, list):
-                self.queue.remove_multiple(contributions)
+                acct = self.queue.remove_multiple(contributions)
+                self.removal_account = acct.merged(self.removal_account)
         step = Step.from_output(batch)
         return step.extend(self._try_propose())
 
@@ -154,4 +174,6 @@ class QueueingHoneyBadger(ConsensusProtocol):
         if not self.dhb.netinfo.is_validator() or self.dhb.hb.has_input:
             return Step()
         sample = self.queue.choose(self.rng, self.batch_size)
+        if self.sample_listener is not None:
+            self.sample_listener(sample)
         return self._wrap(self.dhb.propose(sample, self.rng))

@@ -1,0 +1,161 @@
+"""Bounded mempool: admission, backpressure, and eviction over
+:class:`~hbbft_tpu.protocols.transaction_queue.TransactionQueue`.
+
+The unbounded reference queue grows without limit under overload — at
+"millions of users" that is an OOM, not a design.  :class:`BoundedMempool`
+puts an admission layer in front:
+
+* **validation first** — ``submit`` is a client-facing path, so every
+  byte is attacker-controlled; the transaction is shape- and size-checked
+  BEFORE any node state is touched (the byzantine-input lint family
+  enforces this ordering for the whole package), and a bad transaction is
+  an accounting outcome, never an exception;
+* **capacity** — at ``capacity`` entries the pool either rejects the
+  newcomer (``policy="reject"``, protecting in-flight work) or evicts the
+  oldest pending entry (``policy="evict_oldest"``, favoring fresh load);
+* **backpressure** — ``backpressure`` trips at ``hi_frac`` of capacity
+  and clears at ``lo_frac`` (hysteresis, so the signal doesn't flap at
+  the boundary); closed-loop sources honor it, open-loop sources keep
+  pushing and the admission accounting shows the shed load.
+
+Admission outcomes are strings (``accepted`` / ``duplicate`` /
+``invalid`` / ``dropped`` / ``evicted_oldest``) consumed by
+:class:`~hbbft_tpu.traffic.tracker.TxTracker`.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+from hbbft_tpu.protocols.transaction_queue import RemovalAccount, TransactionQueue
+
+#: admission outcomes (``submit`` return values)
+OUTCOMES = ("accepted", "duplicate", "invalid", "dropped", "evicted_oldest")
+
+
+def default_validate(tx: Any, max_payload: int) -> bool:
+    """Shape check for the canonical ``("tx", client, seq, payload)``
+    transaction: exact arity, typed fields, bounded payload."""
+    if not isinstance(tx, tuple) or len(tx) != 4:
+        return False
+    tag, client, seq, payload = tx
+    if tag != "tx" or not isinstance(client, int) or not isinstance(seq, int):
+        return False
+    if client < 0 or seq < 0:
+        return False
+    if not isinstance(payload, bytes) or len(payload) > max_payload:
+        return False
+    return True
+
+
+class BoundedMempool:
+    """Capacity-bounded admission wrapper around TransactionQueue."""
+
+    def __init__(
+        self,
+        capacity: int,
+        policy: str = "reject",
+        max_payload: int = 1 << 16,
+        hi_frac: float = 0.9,
+        lo_frac: float = 0.7,
+        validate=None,
+    ) -> None:
+        if capacity < 1:
+            raise ValueError("capacity must be >= 1")
+        if policy not in ("reject", "evict_oldest"):
+            raise ValueError(f"unknown mempool policy {policy!r}")
+        self.capacity = capacity
+        self.policy = policy
+        self.max_payload = max_payload
+        self.hi = max(1, int(capacity * hi_frac))
+        self.lo = int(capacity * lo_frac)
+        self._validate = validate or (
+            lambda tx: default_validate(tx, self.max_payload)
+        )
+        self._q = TransactionQueue()
+        self._backpressure = False
+        #: the tx displaced by the most recent ``evicted_oldest`` submit
+        #: (None otherwise) — the driver releases its tracker lifecycle
+        #: when no other mempool still holds a copy
+        self.last_evicted: Optional[Any] = None
+        # admission accounting (monotonic)
+        self.accepted = 0
+        self.duplicates = 0
+        self.invalid = 0
+        self.dropped = 0
+        self.evicted = 0
+        self.peak_depth = 0
+
+    # -- admission (client-facing: validate before any state change) ---------
+
+    def submit(self, tx: Any) -> str:
+        ok = self._validate(tx)
+        if not ok:
+            self.invalid += 1
+            return "invalid"
+        if tx in self._q:
+            self.duplicates += 1
+            return "duplicate"
+        outcome = "accepted"
+        self.last_evicted = None
+        if len(self._q) >= self.capacity:
+            if self.policy == "reject":
+                self.dropped += 1
+                return "dropped"
+            self.last_evicted = self._q.pop_oldest()
+            self.evicted += 1
+            outcome = "evicted_oldest"
+        self._q.push(tx)
+        self.accepted += 1
+        depth = len(self._q)
+        if depth > self.peak_depth:
+            self.peak_depth = depth
+        self._update_backpressure(depth)
+        return outcome
+
+    def _update_backpressure(self, depth: int) -> None:
+        if self._backpressure:
+            if depth <= self.lo:
+                self._backpressure = False
+        elif depth >= self.hi:
+            self._backpressure = True
+
+    # -- proposal / commit sides --------------------------------------------
+
+    def choose(self, rng, amount: int) -> List[Any]:
+        return self._q.choose(rng, amount)
+
+    def remove_committed(self, txs) -> RemovalAccount:
+        acct = self._q.remove_multiple(txs)
+        self._update_backpressure(len(self._q))
+        return acct
+
+    # -- introspection -------------------------------------------------------
+
+    @property
+    def backpressure(self) -> bool:
+        return self._backpressure
+
+    @property
+    def depth(self) -> int:
+        return len(self._q)
+
+    def __len__(self) -> int:
+        return len(self._q)
+
+    def __contains__(self, tx: Any) -> bool:
+        return tx in self._q
+
+    def status(self) -> dict:
+        return {
+            "depth": len(self._q),
+            "capacity": self.capacity,
+            "policy": self.policy,
+            "backpressure": self._backpressure,
+            "accepted": self.accepted,
+            "duplicates": self.duplicates,
+            "invalid": self.invalid,
+            "dropped": self.dropped,
+            "evicted": self.evicted,
+            "peak_depth": self.peak_depth,
+        }

@@ -1,0 +1,180 @@
+"""Per-transaction lifecycle tracking: submit → queue → sampled → committed.
+
+One :class:`TxTracker` observes every transaction the driver pushes at
+the network and every Batch the network emits, and turns the stream into
+the traffic subsystem's first-class metrics:
+
+* ``tx_commit_latency`` histogram — submit time to commit time, in epoch
+  units (p50/p90/p99 ride bench rows and heartbeats as ``tx_commit_p99``
+  etc.; log-bucketed obs/histogram.py, so soak horizons stay O(1) memory
+  per sample);
+* ``tx_queue_latency`` histogram — submit to first sampled-into-proposal
+  (the mempool-dwell component of commit latency);
+* sustained committed-tx counter + drop/duplicate/shed accounting, so an
+  overload run shows WHERE the offered load went (committed vs dropped at
+  admission vs duplicate-submitted vs committed-elsewhere).
+
+Commit dedup is cross-proposer: N decorrelated samples overlap, and a
+transaction is committed once no matter how many proposals carried it —
+``committed_duplicates`` counts the redundant copies.  A commit for a
+transaction the tracker never saw submitted (possible when a driver only
+tracks a subset of clients) is ``committed_unseen``, distinguishable from
+the mempool's committed-elsewhere removals via
+:class:`~hbbft_tpu.protocols.transaction_queue.RemovalAccount`.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Any, Dict, Iterable, Optional
+
+from hbbft_tpu.obs.histogram import Histogram
+from hbbft_tpu.utils import canonical
+
+
+def _commit_digest(tx: Any) -> bytes:
+    """Compact identity for the lifetime committed-set: canonical bytes
+    hashed to 16 bytes, so dedup costs O(1) memory per committed tx
+    regardless of payload size (a soak at thousands of tx/s would
+    otherwise retain every payload tuple forever).  sha256, not
+    ``hash()`` — Python's randomized hashing would break the
+    cross-process seeded-replay fingerprint contract."""
+    return hashlib.sha256(canonical.encode(tx)).digest()[:16]
+
+
+class TxTracker:
+    """Lifecycle observer; all times are virtual (epoch units)."""
+
+    def __init__(self, hist_factory=None) -> None:
+        # hist_factory: Tracer.hist-compatible callable so a live tracer
+        # owns the histograms (bench rows pick them up via hist_summary);
+        # standalone use gets private Histograms.
+        if hist_factory is None:
+            self._own: Dict[str, Histogram] = {}
+
+            def hist_factory(name: str) -> Histogram:
+                h = self._own.get(name)
+                if h is None:
+                    h = self._own[name] = Histogram(name)
+                return h
+
+        self.hist = hist_factory
+        self._pending: Dict[Any, float] = {}  # tx -> submit time
+        self._sampled_at: Dict[Any, float] = {}  # tx -> first proposal time
+        self._committed: set = set()  # _commit_digest(tx) — never raw txs
+        self.submitted = 0
+        self.committed = 0
+        self.committed_duplicates = 0  # redundant cross-proposer copies
+        self.committed_unseen = 0  # committed but never tracked as submitted
+        self.dropped = 0  # rejected at admission (mempool full)
+        self.duplicate_submissions = 0  # client re-submitted a known tx
+        self.invalid = 0  # failed admission validation
+        self.shed = 0  # backpressure-deferred by a closed-loop source
+
+    # -- lifecycle events ----------------------------------------------------
+
+    def on_submit(self, tx: Any, t: float) -> None:
+        self.submitted += 1
+        if tx not in self._pending and _commit_digest(tx) not in self._committed:
+            self._pending[tx] = t
+
+    def on_admission(self, outcome: str, tx: Any = None) -> None:
+        """Aggregate one admission verdict (mempool.submit return).
+
+        A transaction rejected everywhere (``dropped``/``invalid``) will
+        never commit, so its pending entry is released immediately —
+        otherwise an overload soak leaks tracker memory linearly in
+        offered load and ``pending`` can never drain to the starved
+        state.  (``duplicate`` means the tx is already live in a
+        mempool, so its original pending entry stays.)"""
+        if outcome == "dropped":
+            self.dropped += 1
+        elif outcome == "duplicate":
+            self.duplicate_submissions += 1
+        elif outcome == "invalid":
+            self.invalid += 1
+        if outcome in ("dropped", "invalid") and tx is not None:
+            self._pending.pop(tx, None)
+            self._sampled_at.pop(tx, None)
+
+    def on_shed(self, n: int = 1) -> None:
+        self.shed += n
+
+    def on_evicted(self, tx: Any) -> None:
+        """A tx evicted from its last mempool can never commit: release
+        its lifecycle entries (the mempool's ``evicted`` counter owns the
+        accounting), or evict-policy soaks leak tracker memory."""
+        self._pending.pop(tx, None)
+        self._sampled_at.pop(tx, None)
+
+    def on_sampled(self, txs: Iterable[Any], t: float) -> None:
+        """First inclusion in a proposal: close the queue-dwell interval."""
+        qh = self.hist("tx_queue_latency")
+        for tx in txs:
+            if tx in self._sampled_at:
+                continue
+            sub = self._pending.get(tx)
+            if sub is None:
+                continue
+            self._sampled_at[tx] = t
+            qh.record(t - sub)
+
+    def on_committed(self, txs: Iterable[Any], t: float) -> int:
+        """Record a Batch's transactions; returns newly-committed count."""
+        ch = self.hist("tx_commit_latency")
+        new = 0
+        for tx in txs:
+            d = _commit_digest(tx)
+            if d in self._committed:
+                self.committed_duplicates += 1
+                continue
+            self._committed.add(d)
+            new += 1
+            sub = self._pending.pop(tx, None)
+            self._sampled_at.pop(tx, None)
+            if sub is None:
+                self.committed_unseen += 1
+            else:
+                ch.record(t - sub)
+        self.committed += new
+        return new
+
+    # -- summaries -----------------------------------------------------------
+
+    @property
+    def pending(self) -> int:
+        return len(self._pending)
+
+    def latency_summary(self) -> Dict[str, float]:
+        return self.hist("tx_commit_latency").summary()
+
+    def commit_p99(self) -> float:
+        return self.hist("tx_commit_latency").percentile(99)
+
+    def summary(self) -> Dict[str, Any]:
+        return {
+            "submitted": self.submitted,
+            "committed": self.committed,
+            "pending": self.pending,
+            "dropped": self.dropped,
+            "duplicate_submissions": self.duplicate_submissions,
+            "invalid": self.invalid,
+            "shed": self.shed,
+            "committed_duplicates": self.committed_duplicates,
+            "committed_unseen": self.committed_unseen,
+            "commit_latency": self.latency_summary(),
+            "queue_latency": self.hist("tx_queue_latency").summary(),
+        }
+
+    def fingerprint(self) -> Dict[str, Any]:
+        """Replay-determinism digest: exact counters plus the raw commit-
+        latency bucket counts (two same-seed runs must match bit for bit;
+        tests/test_traffic.py seeded-replay contract)."""
+        h = self.hist("tx_commit_latency")
+        return {
+            "submitted": self.submitted,
+            "committed": self.committed,
+            "dropped": self.dropped,
+            "duplicates": self.duplicate_submissions,
+            "latency_buckets": sorted(h.counts.items()),
+        }

@@ -1,0 +1,238 @@
+"""Client-load workload generators for the traffic subsystem.
+
+"Heavy traffic from millions of users" as *data*: a client population
+(Zipf-ranked — a few hot clients dominate, a long tail trickles), an
+arrival process (open-loop Poisson rate sweeps, or closed-loop fixed
+concurrency), and a payload-size distribution.  Every generator draws
+entropy ONLY from the rng injected per call (the determinism lint family
+covers this package: same seed ⇒ bit-identical arrival schedule), so a
+traffic run is replayable end to end — arrivals, sampled proposals,
+Batches, and latency histograms all reproduce.
+
+Transactions are plain canonical-codec trees (``("tx", client, seq,
+payload)`` tuples): hashable for the mempool's dedup dict, and they
+round-trip exactly through ``utils/canonical`` when a proposal sample is
+framed into a contribution.
+
+Time is virtual: one epoch = one unit.  Open-loop arrivals carry
+fractional submit times inside their epoch (uniform order statistics,
+which conditioned on the Poisson count IS the Poisson process), so
+commit latency = commit_epoch − submit_time is exact in epoch units.
+"""
+
+from __future__ import annotations
+
+import math
+from bisect import bisect_left
+from itertools import accumulate
+from typing import Any, List, Optional, Tuple
+
+#: canonical transaction shape: ("tx", client_id, per-client seq, payload)
+Tx = Tuple[str, int, int, bytes]
+
+
+def make_tx(client: int, seq: int, payload: bytes) -> Tx:
+    return ("tx", client, seq, payload)
+
+
+class PayloadSizes:
+    """Payload-size distribution: ``fixed`` | ``uniform`` | ``bimodal``.
+
+    ``bimodal`` models the realistic mix (many small transfers, a thin
+    stream of large blobs): ``small`` bytes with probability
+    ``1 - heavy_frac``, else ``large`` bytes.
+    """
+
+    def __init__(
+        self,
+        kind: str = "fixed",
+        size: int = 64,
+        lo: int = 16,
+        hi: int = 256,
+        small: int = 32,
+        large: int = 1024,
+        heavy_frac: float = 0.05,
+    ) -> None:
+        if kind not in ("fixed", "uniform", "bimodal"):
+            raise ValueError(f"unknown payload kind {kind!r}")
+        self.kind = kind
+        self.size = size
+        self.lo, self.hi = lo, hi
+        self.small, self.large = small, large
+        self.heavy_frac = heavy_frac
+
+    def draw(self, rng) -> int:
+        if self.kind == "fixed":
+            return self.size
+        if self.kind == "uniform":
+            return rng.randrange(self.lo, self.hi + 1)
+        return self.large if rng.random() < self.heavy_frac else self.small
+
+    def describe(self) -> dict:
+        if self.kind == "fixed":
+            return {"kind": "fixed", "size": self.size}
+        if self.kind == "uniform":
+            return {"kind": "uniform", "lo": self.lo, "hi": self.hi}
+        return {
+            "kind": "bimodal",
+            "small": self.small,
+            "large": self.large,
+            "heavy_frac": self.heavy_frac,
+        }
+
+
+class ZipfPopulation:
+    """Zipf(α)-ranked client population: client ``r`` (0-based rank) is
+    drawn with weight ``1/(r+1)^alpha``.  Sampling is O(log C) via a
+    precomputed CDF, so million-client populations cost one bisect per
+    transaction, not a pass over the population."""
+
+    def __init__(self, num_clients: int, alpha: float = 1.1) -> None:
+        if num_clients < 1:
+            raise ValueError("num_clients must be >= 1")
+        self.num_clients = num_clients
+        self.alpha = alpha
+        weights = [1.0 / (r + 1) ** alpha for r in range(num_clients)]
+        self._cdf = list(accumulate(weights))
+        self._total = self._cdf[-1]
+
+    def sample(self, rng) -> int:
+        return bisect_left(self._cdf, rng.random() * self._total)
+
+    def describe(self) -> dict:
+        return {"clients": self.num_clients, "alpha": self.alpha}
+
+
+def _poisson(rng, lam: float) -> int:
+    """Deterministic-given-rng Poisson draw.  Knuth's product method is
+    exact but its ``exp(-lam)`` underflows past ~700, so large rates are
+    drawn as a sum of independent chunks (Poisson is closed under
+    addition) — still exact, still replayable."""
+    count = 0
+    while lam > 0:
+        chunk = min(lam, 500.0)
+        lam -= chunk
+        limit = math.exp(-chunk)
+        prod = rng.random()
+        while prod > limit:
+            count += 1
+            prod *= rng.random()
+    return count
+
+
+class OpenLoopSource:
+    """Open-loop Poisson arrivals: ``rate`` transactions per epoch
+    network-wide, regardless of what the system commits (the load a
+    population of independent clients actually presents).  Payload bytes
+    are derived from (client, seq) — cheap and reproducible without
+    burning rng draws per byte."""
+
+    name = "open_loop"
+
+    def __init__(
+        self,
+        rate: float,
+        population: ZipfPopulation,
+        payloads: Optional[PayloadSizes] = None,
+    ) -> None:
+        self.rate = rate
+        self.population = population
+        self.payloads = payloads or PayloadSizes()
+        self._seqs: dict = {}  # client -> next seq
+        self.generated = 0
+
+    def arrivals(self, rng, epoch: int, backpressure: bool = False) -> List[Tuple[float, Tx]]:
+        """(submit_time, tx) pairs for one epoch, times ascending in
+        [epoch, epoch+1).  Open-loop clients do not slow down under
+        backpressure — overload shedding is the mempool's job."""
+        count = _poisson(rng, self.rate)
+        times = sorted(rng.random() for _ in range(count))
+        out: List[Tuple[float, Tx]] = []
+        for t in times:
+            client = self.population.sample(rng)
+            seq = self._seqs.get(client, 0)
+            self._seqs[client] = seq + 1
+            size = self.payloads.draw(rng)
+            payload = _payload_bytes(client, seq, size)
+            out.append((epoch + t, make_tx(client, seq, payload)))
+        self.generated += count
+        return out
+
+    def on_committed(self, n: int) -> None:  # open loop ignores completions
+        pass
+
+    def on_rejected(self, n: int) -> None:  # ...and admission rejections
+        pass
+
+    def describe(self) -> dict:
+        return {
+            "source": self.name,
+            "rate_per_epoch": self.rate,
+            "population": self.population.describe(),
+            "payloads": self.payloads.describe(),
+        }
+
+
+class ClosedLoopSource:
+    """Closed-loop fixed concurrency: each of ``concurrency`` virtual
+    clients keeps exactly one transaction in flight, submitting a
+    replacement only when one commits — the classic saturation-free load
+    shape.  Honors backpressure: a mempool signaling overload defers the
+    top-up to the next epoch."""
+
+    name = "closed_loop"
+
+    def __init__(
+        self,
+        concurrency: int,
+        population: ZipfPopulation,
+        payloads: Optional[PayloadSizes] = None,
+    ) -> None:
+        self.concurrency = concurrency
+        self.population = population
+        self.payloads = payloads or PayloadSizes()
+        self._seqs: dict = {}
+        self.in_flight = 0
+        self.generated = 0
+
+    def arrivals(self, rng, epoch: int, backpressure: bool = False) -> List[Tuple[float, Tx]]:
+        if backpressure:
+            return []
+        want = self.concurrency - self.in_flight
+        out: List[Tuple[float, Tx]] = []
+        times = sorted(rng.random() for _ in range(max(want, 0)))
+        for t in times:
+            client = self.population.sample(rng)
+            seq = self._seqs.get(client, 0)
+            self._seqs[client] = seq + 1
+            size = self.payloads.draw(rng)
+            out.append((epoch + t, make_tx(client, seq, _payload_bytes(client, seq, size))))
+        self.in_flight += len(out)
+        self.generated += len(out)
+        return out
+
+    def on_committed(self, n: int) -> None:
+        self.in_flight = max(0, self.in_flight - n)
+
+    def on_rejected(self, n: int) -> None:
+        """A submission rejected at admission (mempool full/invalid) will
+        never commit: release its concurrency slot, or the effective
+        window silently shrinks by every rejection for the rest of the
+        run (with concurrency > capacity the source would stop
+        generating entirely)."""
+        self.in_flight = max(0, self.in_flight - n)
+
+    def describe(self) -> dict:
+        return {
+            "source": self.name,
+            "concurrency": self.concurrency,
+            "population": self.population.describe(),
+            "payloads": self.payloads.describe(),
+        }
+
+
+def _payload_bytes(client: int, seq: int, size: int) -> bytes:
+    """Deterministic payload content of exactly ``size`` bytes."""
+    stamp = client.to_bytes(8, "big") + seq.to_bytes(8, "big")
+    reps = -(-size // len(stamp))
+    return (stamp * reps)[:size]

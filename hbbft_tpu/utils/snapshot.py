@@ -120,7 +120,14 @@ def _state_attrs(obj: Any) -> List[Tuple[str, Any]]:
     contribute none — that must not force the dict path) and merges any
     instance ``__dict__`` on top, so hybrid classes (slotted dataclass over
     a dict-backed base) serialize completely.  Sorted for determinism.
+
+    Attributes named in the class's ``_SNAPSHOT_ENV_ATTRS`` are dropped:
+    they hold environment (live observer callables, attached drivers —
+    e.g. QueueingHoneyBadger.sample_listener, VirtualNet.traffic), not
+    consensus state, and restore falls back to the class default exactly
+    like the backend/tracer contract.
     """
+    env = getattr(type(obj), "_SNAPSHOT_ENV_ATTRS", ())
     attrs: Dict[str, Any] = {}
     for c in reversed(type(obj).__mro__):
         s = c.__dict__.get("__slots__")
@@ -132,7 +139,7 @@ def _state_attrs(obj: Any) -> List[Tuple[str, Any]]:
             if hasattr(obj, name):
                 attrs[name] = getattr(obj, name)
     attrs.update(getattr(obj, "__dict__", None) or {})
-    return sorted(attrs.items())
+    return sorted((n, v) for n, v in attrs.items() if n not in env)
 
 
 # ---------------------------------------------------------------------------

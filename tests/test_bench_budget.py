@@ -53,6 +53,18 @@ def test_legacy_plan_unchanged_without_budget(bench):
     assert names.index("rlc_dec") > names.index("rlc_sig")
 
 
+def test_qhb_traffic_planned_both_modes(bench):
+    """The traffic curve row is part of both orderings (it is the only
+    row measuring sustained tx/s + commit latency), sits after the
+    flagship crypto prefix under a budget, and carries a cost estimate."""
+    for budget in (0.0, 3000.0):
+        names = [n for n, _ in bench._plan_benches(None, "tpu", budget)]
+        assert "qhb_traffic" in names
+    budgeted = [n for n, _ in bench._plan_benches(None, "tpu", 3000.0)]
+    assert budgeted.index("qhb_traffic") < budgeted.index("rs_encode")
+    assert "qhb_traffic" in bench._BENCH_EST_S
+
+
 def test_n100_tpu_gating(bench):
     # off-TPU driver runs never attempt the real-crypto N=100 row...
     assert "array_n100_tpu" not in [

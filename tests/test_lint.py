@@ -170,6 +170,25 @@ def test_determinism_out_of_scope_paths_ignored():
     assert len(lint_sources(DeterminismRule(), {"hbbft_tpu/core/_x.py": src})) == 1
 
 
+def test_determinism_covers_traffic_package():
+    """The traffic subsystem carries the seeded-replay contract: wall
+    clocks and ambient randomness are banned exactly as in protocols/
+    (generators must draw entropy only from the injected rng)."""
+    src = """\
+    import random
+
+    class Source:
+        def arrivals(self, epoch):
+            return [random.random() for _ in range(3)]
+    """
+    findings = lint_sources(
+        DeterminismRule(), {"hbbft_tpu/traffic/_seeded.py": src}
+    )
+    msgs = [f.message for f in findings]
+    assert any("nondeterministic module 'random'" in m for m in msgs)
+    assert any("random.random()" in m for m in msgs)
+
+
 # ---------------------------------------------------------------------------
 # Rule family 2: handler exhaustiveness
 # ---------------------------------------------------------------------------
@@ -358,6 +377,62 @@ def test_byzantine_handle_input_out_of_scope():
     class P:
         def handle_input(self, input, rng=None):
             raise ValueError("unknown input kind")
+    """
+    assert lint_sources(ByzantineInputRule(), {BYZ_PATH: src}) == []
+
+
+TRAFFIC_PATH = "hbbft_tpu/traffic/_seeded.py"
+
+
+def test_byzantine_traffic_submit_write_before_validate_flagged():
+    """Client-facing admission in hbbft_tpu/traffic/: the first self-state
+    write must come after a *valid*-named call (every submitted byte is
+    attacker-controlled)."""
+    src = """\
+    class Pool:
+        def submit(self, tx):
+            self.pending.append(tx)
+            if not self._validate(tx):
+                return "invalid"
+            return "accepted"
+    """
+    findings = lint_sources(ByzantineInputRule(), {TRAFFIC_PATH: src})
+    assert len(findings) == 1
+    assert "writes state before validating" in findings[0].message
+
+
+def test_byzantine_traffic_submit_validate_first_passes():
+    src = """\
+    class Pool:
+        def submit(self, tx):
+            if not self._validate(tx):
+                self.invalid += 1
+                return "invalid"
+            self.pending.append(tx)
+            return "accepted"
+    """
+    assert lint_sources(ByzantineInputRule(), {TRAFFIC_PATH: src}) == []
+
+
+def test_byzantine_traffic_submit_raise_flagged():
+    src = """\
+    class Pool:
+        def submit(self, tx):
+            if not self._validate(tx):
+                raise ValueError("bad tx")
+            return "accepted"
+    """
+    findings = lint_sources(ByzantineInputRule(), {TRAFFIC_PATH: src})
+    assert len(findings) == 1
+    assert "raises on client input" in findings[0].message
+
+
+def test_byzantine_submit_outside_traffic_scope_ignored():
+    src = """\
+    class Pool:
+        def submit(self, tx):
+            self.pending.append(tx)
+            return "accepted"
     """
     assert lint_sources(ByzantineInputRule(), {BYZ_PATH: src}) == []
 

@@ -243,6 +243,20 @@ do_adv_matrix() {
     BENCH_ADVM_GROUPS=100 BENCH_ADVM_K=100 BENCH_ADVM_ITERS=2 \
     timeout 7200 python bench.py
 }
+done_qhb_traffic() {
+  has_row "$ART/rows_after_qhb_traffic.json" qhb_traffic
+}
+do_qhb_traffic() {
+  # QHB traffic curve ON DEVICE: batch-size x arrival-rate grid at N=16
+  # real crypto (every epoch's shares/pairings/combines through
+  # TpuBackend) + the N=100 f=33 point — sustained tx/s and p50/p99
+  # commit latency as first-class rows next to epochs/s.  Short grid: 2
+  # epochs/cell; the mock-backend curve in the driver bench already
+  # charts the full shape, this step banks the real-crypto anchor.
+  HBBFT_TPU_FQ_IMPL=rns BENCH_ONLY=qhb_traffic BENCH_QHB_BACKEND=tpu \
+    BENCH_QHB_EPOCHS=2 BENCH_QHB_BATCHES=16,64 BENCH_QHB_RATES=0.5,1.0,2.0 \
+    BENCH_QHB_N100=0 timeout 7200 python bench.py
+}
 done_n32_churn() {
   has_row "$ART/rows_after_n32_churn.json" array_epochs_per_sec_n100 \
     backend=TpuBackend n=32
@@ -282,7 +296,7 @@ do_n100_churn() {
     timeout 18000 python bench.py
 }
 
-STEPS="n100 matrix_rns_a matrix_limb_a matrix_rns_b matrix_limb_b glv_ab host_ab adv_matrix n16_churn flips10k kernel_levers driver_budget rs_ab n32_churn n64coin n100_churn"
+STEPS="n100 matrix_rns_a matrix_limb_a matrix_rns_b matrix_limb_b glv_ab host_ab adv_matrix qhb_traffic n16_churn flips10k kernel_levers driver_budget rs_ab n32_churn n64coin n100_churn"
 
 for s in $STEPS; do
   if "done_$s"; then

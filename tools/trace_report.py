@@ -19,6 +19,12 @@ Two subcommands on one small CLI:
   (scenario_matrix / adv_matrix captures): count changes print, and a
   kind that VANISHED while its row persists (an attack that stopped
   being detected) exits 1.
+* ``python tools/trace_report.py --traffic OLD NEW`` — diff the
+  ``qhb_traffic`` throughput/latency curves cell by cell: a sustained
+  tx/s drop beyond ``--tol`` (default 10%) OR a p99 commit-latency
+  increase beyond it is a regression (exit 1) — latency is
+  lower-is-better, unlike every other bench metric, so the generic
+  ``--diff`` mode cannot gate it.
 
 The validation helpers are imported by the test suite
 (tests/test_obs_tracer.py, tests/test_trace_smoke.py) — keep them
@@ -378,6 +384,93 @@ def report_faults(old_path: str, new_path: str) -> int:
     return 1 if lost else 0
 
 
+def _traffic_cells(path: str) -> Dict[Tuple, Dict[str, Any]]:
+    """(metric, n, batch_size, rate_frac) -> cell for every traffic-curve
+    row (a ``curve`` list of cells plus the optional ``n100`` cell)."""
+    with open(path) as f:
+        doc = json.load(f)
+    rows = doc["rows"] if isinstance(doc, dict) else doc
+    out: Dict[Tuple, Dict[str, Any]] = {}
+    for r in rows:
+        if not isinstance(r.get("curve"), list):
+            continue
+        cells = list(r["curve"])
+        if isinstance(r.get("n100"), dict):
+            cells.append(r["n100"])
+        for c in cells:
+            if not isinstance(c, dict) or "batch_size" not in c:
+                continue
+            key = (
+                r["metric"],
+                c.get("n"),
+                c["batch_size"],
+                c.get("rate_frac"),
+            )
+            out[key] = c
+    return out
+
+
+def diff_traffic(
+    old_path: str, new_path: str, tol: float = 0.10
+) -> List[Dict[str, Any]]:
+    """Cell-by-cell comparison of traffic curves.  Two regression axes,
+    because latency is lower-is-better: sustained tx/s dropping more than
+    ``tol``, or p99 commit latency rising more than ``tol``."""
+    old, new = _traffic_cells(old_path), _traffic_cells(new_path)
+    out: List[Dict[str, Any]] = []
+    for key in sorted(set(old) | set(new), key=repr):
+        o, n = old.get(key), new.get(key)
+        entry: Dict[str, Any] = {
+            "cell": {
+                "metric": key[0], "n": key[1],
+                "batch_size": key[2], "rate_frac": key[3],
+            }
+        }
+        if o is None or n is None:
+            entry["status"] = "only_in_new" if o is None else "only_in_old"
+            entry["regression"] = False
+            out.append(entry)
+            continue
+        o_tx, n_tx = o.get("tx_per_s", 0), n.get("tx_per_s", 0)
+        o_p99, n_p99 = o.get("latency_p99", 0), n.get("latency_p99", 0)
+        entry["old_tx_per_s"], entry["new_tx_per_s"] = o_tx, n_tx
+        entry["old_p99"], entry["new_p99"] = o_p99, n_p99
+        entry["tx_regression"] = bool(o_tx and n_tx < o_tx * (1.0 - tol))
+        entry["p99_regression"] = bool(o_p99 and n_p99 > o_p99 * (1.0 + tol))
+        entry["regression"] = entry["tx_regression"] or entry["p99_regression"]
+        out.append(entry)
+    return out
+
+
+def report_traffic(old_path: str, new_path: str, tol: float) -> int:
+    entries = diff_traffic(old_path, new_path, tol)
+    if not entries:
+        print("no traffic-curve rows found in either capture")
+        return 0
+    regressed = [e for e in entries if e["regression"]]
+    for e in entries:
+        c = e["cell"]
+        label = f"{c['metric']} n={c['n']} B={c['batch_size']} r={c['rate_frac']}"
+        if "status" in e:
+            print(f"{label:>44} {e['status']}")
+            continue
+        flags = "".join(
+            f"  {name}" for name, hit in (
+                ("TX-REGRESSION", e["tx_regression"]),
+                ("P99-REGRESSION", e["p99_regression"]),
+            ) if hit
+        )
+        print(
+            f"{label:>44} tx/s {e['old_tx_per_s']:>10} -> {e['new_tx_per_s']:>10}"
+            f"  p99 {e['old_p99']:>7} -> {e['new_p99']:>7}{flags}"
+        )
+    print(
+        f"{len(regressed)} traffic regression(s) beyond {tol:.0%} "
+        f"across {len(entries)} cells"
+    )
+    return 1 if regressed else 0
+
+
 def report_diff(old_path: str, new_path: str, tol: float) -> int:
     entries = diff_rows(old_path, new_path, tol)
     regressed = [e for e in entries if e["regression"]]
@@ -409,6 +502,12 @@ def main(argv=None) -> int:
         "exit 1 when a previously-detected kind vanished",
     )
     p.add_argument(
+        "--traffic", action="store_true",
+        help="diff qhb_traffic throughput/latency curves cell by cell "
+        "between two BENCH_rows.json files; a >tol tx/s drop or >tol "
+        "p99 commit-latency rise exits 1",
+    )
+    p.add_argument(
         "--tol", type=float, default=0.10,
         help="relative drop flagged as a regression (default 0.10)",
     )
@@ -436,6 +535,13 @@ def main(argv=None) -> int:
         "(default 0.10)",
     )
     args = p.parse_args(argv)
+    if args.traffic:
+        if len(args.paths) != 2:
+            p.error("--traffic needs exactly two BENCH_rows.json paths")
+        rc = report_traffic(args.paths[0], args.paths[1], args.tol)
+        if args.diff:
+            rc = max(rc, report_diff(args.paths[0], args.paths[1], args.tol))
+        return rc
     if args.faults:
         if len(args.paths) != 2:
             p.error("--faults needs exactly two BENCH_rows.json paths")
