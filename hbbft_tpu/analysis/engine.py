@@ -32,6 +32,8 @@ SUPPRESS_RE = re.compile(r"#\s*lint:\s*allow\[([A-Za-z0-9_,\- ]+)\]\s*(.*)")
 
 #: rule id used for meta-findings about malformed suppressions
 ALLOW_RULE_ID = "lint-allow"
+#: rule id for suppressions that no longer suppress anything
+STALE_RULE_ID = "stale-suppression"
 
 
 @dataclass(frozen=True, slots=True)
@@ -66,6 +68,9 @@ class ModuleSource:
         self.allowed: Dict[int, set] = {}
         #: (line, rule-list) of suppressions missing a reason
         self.bare_allows: List[Tuple[int, str]] = []
+        #: honoured allow comments: (comment line, target line, rules) —
+        #: the stale-suppression pass checks each actually fired
+        self.allow_sites: List[Tuple[int, int, frozenset]] = []
         self._scan_suppressions()
 
     def _scan_suppressions(self) -> None:
@@ -90,13 +95,27 @@ class ModuleSource:
             target = line
             code = self.lines[line - 1][: tok.start[1]].strip()
             if not code:
-                # Comment-only line: applies to the next source line.
+                # Comment-only line: applies to the next SOURCE line —
+                # skipping CONTINUATION COMMENT lines only, so a
+                # multi-line justification comment still binds to the
+                # code it precedes.  A blank line ends the binding (the
+                # allow then suppresses nothing and is reported stale)
+                # — skipping blanks would let a dead allow silently
+                # capture the next code block.
                 target = line + 1
+                while target <= len(self.lines):
+                    nxt = self.lines[target - 1].strip()
+                    if not nxt.startswith("#"):
+                        break
+                    target += 1
             self.allowed.setdefault(target, set()).update(rules)
+            self.allow_sites.append((line, target, frozenset(rules)))
 
     def is_suppressed(self, rule: str, line: int) -> bool:
+        # (no wildcard form: SUPPRESS_RE only admits rule-id characters,
+        # so every suppression names the rules it blankets)
         rules = self.allowed.get(line)
-        return rules is not None and (rule in rules or "*" in rules)
+        return rules is not None and rule in rules
 
 
 class LintProject:
@@ -123,6 +142,7 @@ class LintProject:
                 broken.tree = ast.Module(body=[], type_ignores=[])
                 broken.allowed = {}
                 broken.bare_allows = []
+                broken.allow_sites = []
                 broken.syntax_error = e  # type: ignore[attr-defined]
                 modules[rel] = broken
         return LintProject(repo_root, modules)
@@ -173,6 +193,7 @@ def all_rules() -> List[Rule]:
         rules_byzantine,
         rules_determinism,
         rules_exhaustiveness,
+        rules_seam,
         rules_tracer,
     )
 
@@ -255,7 +276,8 @@ def run_lint(
     if paths is None:
         paths = iter_python_files(repo_root / "hbbft_tpu")
     project = LintProject.load(repo_root, paths)
-    if rules is None:
+    full_rule_set = rules is None
+    if full_rule_set:
         rules = all_rules()
 
     findings: List[Finding] = []
@@ -275,10 +297,80 @@ def run_lint(
                     f"suppression allow[{rules_txt}] has no reason; not honoured",
                 )
             )
+    #: (path, line, rule) triples where a suppression actually fired —
+    #: rule-keyed so a dead allow cannot hide behind a DIFFERENT rule's
+    #: live allow on the same line
+    used_allows: set = set()
     for rule in rules:
         for f in rule.check_project(project):
             mod = project.module(f.path)
             if mod is not None and mod.is_suppressed(f.rule, f.line):
+                used_allows.add((f.path, f.line, f.rule))
                 continue
             findings.append(f)
+    # Stale suppressions: an honoured allow comment that suppressed
+    # nothing in this run is itself a finding — dead suppressions
+    # otherwise silently blanket future findings on their line.  Only
+    # meaningful when the full rule set ran (a subset run can't tell
+    # dead from not-exercised).  (A partial FILE run — --diff or an
+    # explicit list — can still transiently report one when the matching
+    # finding needs cross-file context; the gate and --baseline always
+    # run the full set.)
+    if not full_rule_set:
+        return sorted(findings, key=Finding.sort_key)
+    def _fired(path: str, target: int, rules_txt: frozenset) -> bool:
+        return any((path, target, r) in used_allows for r in rules_txt)
+
+    #: candidates: allow sites that suppressed nothing
+    stale = [
+        (path, mod, comment_line, target, rules_txt)
+        for path, mod in project.modules.items()
+        for comment_line, target, rules_txt in mod.allow_sites
+        if not _fired(path, target, rules_txt)
+    ]
+    #: (path, target) -> allow[stale-suppression] site lines binding there
+    stale_sites: Dict[Tuple[str, int], List[int]] = {}
+    for path, _mod, comment_line, target, rules_txt in stale:
+        if STALE_RULE_ID in rules_txt:
+            stale_sites.setdefault((path, target), []).append(comment_line)
+    #: candidates whose stale finding is deliberately allowed, and the
+    #: escape-hatch sites that did the allowing (those are live, not
+    #: stale themselves — the hatch must converge)
+    suppressed: set = set()
+    protectors: set = set()
+    for path, mod, comment_line, target, rules_txt in stale:
+        if STALE_RULE_ID in rules_txt:
+            continue
+        if mod.is_suppressed(STALE_RULE_ID, comment_line):
+            # inline dead allow: the hatch binds to its code line
+            suppressed.add((path, comment_line))
+            for s in stale_sites.get((path, comment_line), ()):
+                protectors.add((path, s))
+            continue
+        # comment-only dead allow: the hatch comment above it skips the
+        # dead comment line and binds to the SAME code line — treat a
+        # co-targeting allow[stale-suppression] as this allow's hatch
+        others = [
+            s
+            for s in stale_sites.get((path, target), ())
+            if s != comment_line
+        ]
+        if others:
+            suppressed.add((path, comment_line))
+            protectors.update((path, s) for s in others)
+    for path, mod, comment_line, target, rules_txt in stale:
+        if (path, comment_line) in suppressed:
+            continue  # its stale finding is deliberately allowed
+        if STALE_RULE_ID in rules_txt and (path, comment_line) in protectors:
+            continue  # this hatch silenced a kept dead allow: live
+        findings.append(
+            Finding(
+                STALE_RULE_ID,
+                path,
+                comment_line,
+                0,
+                f"suppression allow[{','.join(sorted(rules_txt))}] "
+                "matches no finding; remove it",
+            )
+        )
     return sorted(findings, key=Finding.sort_key)

@@ -1,0 +1,163 @@
+"""Seeded order-dependent bugs: the explorer's sensitivity fixtures.
+
+A race detector that silently stops detecting is worse than none, so
+PR 9 pins three *mutants* — deliberately broken backends/listeners whose
+bug only manifests under particular schedules — and tests assert the
+explorer catches each with a minimized, replayable counterexample
+(tests/test_race_explorer.py).  Each mutant is the realistic shape of a
+bug the seam discipline exists to prevent:
+
+* ``accum`` — chunk deliveries EXTEND a shared accumulator in completion
+  order instead of writing their disjoint slots (the PR-3 contract
+  violated).  Shares come back permuted under any non-FIFO resolution,
+  the engine combines the wrong share for an index, and the epoch's
+  decrypt-equality invariant trips — but ONLY on non-default schedules.
+* ``counter`` — the submit path of the next batch reads state the
+  previous batch's delivery callbacks wrote (which chunk resolved LAST)
+  — the adaptive-RLC shape with the observation window read at the
+  wrong time.  Verdicts stay correct; the schedule leaks into a
+  fingerprinted probe counter.  This is also the source shape the
+  static ``seam-race`` rule catches (tests/test_lint.py runs the rule
+  over this very module).
+* ``listener`` — a chunk-resolution listener submits transactions into
+  the live mempools MID-EPOCH, so the next epoch's contribution
+  sampling depends on the resolution order (the traffic-hook seam
+  violated).  Batches themselves diverge.
+
+These classes are exercised only by the explorer and the lint tests —
+nothing in the production paths imports them.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Sequence
+
+from hbbft_tpu.crypto.backend import MockBackend
+
+
+class AccumulatingResolveBackend(MockBackend):
+    """Seeded bug 1: delivery extends a shared list in resolution order.
+
+    ``decrypt_shares_batch`` rides the chunk pipeline with an
+    ``out.extend`` delivery — correct only when chunks resolve FIFO.
+    """
+
+    def decrypt_shares_batch(self, items):
+        out: List[Any] = []
+        step = self.pipeline_chunk or len(items) or 1
+        b = self._batch_seq
+        self._batch_seq += 1
+        for ci, lo in enumerate(range(0, len(items), step)):
+            chunk = items[lo : lo + step]
+            self._pipe.submit(
+                lambda chunk=chunk: [
+                    sk.decrypt_share_unchecked(ct) for sk, ct in chunk
+                ],
+                fetch=None,
+                kind=f"b{b}.c{ci}",
+                items=len(chunk),
+                on_result=out.extend,  # BUG: completion order, not slots
+            )
+        self._pipe.flush(order=self._resolution_order())
+        return out
+
+
+class SubmitReadsResolveBackend(MockBackend):
+    """Seeded bug 2: a submit-path read of resolve-path state.
+
+    Delivery callbacks record which chunk resolved last; the NEXT
+    batch's submit path folds that into a probe counter — so the probe's
+    final value encodes the chosen resolution permutations.  The
+    verdicts stay correct (slot writes are untouched); the fingerprint's
+    ``extra`` channel exposes the leak, exactly like a group-sizing or
+    batching decision would leak into dispatch structure.
+    """
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._last_resolved_lo = 0  # resolve-path state
+        self._probe_acc = 0
+
+    def _piped_submit(self, items: Sequence, compute: Callable[[Sequence], List]):
+        # BUG (seam-race shape): submit path reads _last_resolved_lo,
+        # which the previous batch's delivery callbacks wrote
+        self._probe_acc = (self._probe_acc * 31 + self._last_resolved_lo) & (
+            (1 << 30) - 1
+        )
+        out, finish = super()._piped_submit(items, compute)
+        return out, finish
+
+    # record resolve-order state from the delivery side
+    @property
+    def chunk_listeners(self):  # type: ignore[override]
+        def deliver(lo, res):
+            # BUG (seam-race shape): resolve-path write of state the
+            # submit path above reads
+            self._last_resolved_lo = lo
+
+        return (deliver,) + tuple(self.__dict__.get("_extra_listeners", ()))
+
+    @chunk_listeners.setter
+    def chunk_listeners(self, value):
+        self.__dict__["_extra_listeners"] = tuple(value)
+
+    def race_extra(self) -> Dict[str, int]:
+        return {"probe_acc": self._probe_acc}
+
+
+def mid_epoch_mempool_listener(driver) -> Callable:
+    """Seeded bug 3: a listener mutating mempool state mid-epoch.
+
+    On every chunk resolution it pushes a transaction tagged with the
+    chunk's offset into the driver's mempools — so mempool insertion
+    order (and therefore the next epoch's sampled contributions) depends
+    on the resolution schedule."""
+    seq = [0]
+
+    def on_chunk(lo, res):
+        seq[0] += 1
+        # well-formed canonical tx so admission ACCEPTS it — the bug is
+        # the timing, not the shape (client id encodes the chunk offset)
+        tx = ("tx", 1_000_000 + lo, seq[0], b"inflight")
+        for mp in driver.mempools:
+            mp.submit(tx)  # BUG: admission outside the epoch boundary
+
+    return on_chunk
+
+
+def target_runner(name: str):
+    """Explorer runners for the seeded mutants (analysis/schedules.py
+    ``target_runner("mutant:<name>")``)."""
+    from hbbft_tpu.analysis import schedules
+
+    if name == "accum":
+
+        def run_accum(controller, tracker, n, seed):
+            return schedules.run_pipeline_target(
+                controller, tracker, n, seed,
+                backend_factory=AccumulatingResolveBackend,
+            )
+
+        return run_accum
+    if name == "counter":
+
+        def run_counter(controller, tracker, n, seed):
+            return schedules.run_pipeline_target(
+                controller, tracker, n, seed,
+                backend_factory=SubmitReadsResolveBackend,
+            )
+
+        return run_counter
+    if name == "listener":
+
+        def run_listener(controller, tracker, n, seed):
+            return schedules.run_traffic_target(
+                controller, tracker, n, seed,
+                chunk_listener_factory=mid_epoch_mempool_listener,
+            )
+
+        return run_listener
+    raise KeyError(f"unknown mutant {name!r}")
+
+
+MUTANT_NAMES = ("accum", "counter", "listener")

@@ -18,13 +18,19 @@ remote peer controls every byte that reaches a
   non-member can grow per-sender maps or future-message queues without
   bound (memory DoS) or influence quorum counts.
 
-Both checks are per-method AST heuristics over the handler body only:
-delegation into ``_handle_*`` helpers is trusted (the helpers' own checks
-are exercised by the adversarial tests).  Remote handlers are methods
-named ``handle_*`` whose parameter list includes ``sender_id`` or
-``sender`` — matching ``ConsensusProtocol.handle_message`` and the
-SyncKeyGen ``handle_part``/``handle_ack`` family; ``handle_input`` (local
-input, trusted embedder) is deliberately out of scope.
+The membership check is interprocedural ONE call level deep (PR 9):
+when a handler passes its sender parameter into a same-class helper
+before any membership check, the helper body is scanned with the
+argument mapped onto its parameter — a helper that itself checks
+membership (or runs a ``*valid*``-named validation call on the sender)
+*credits* the handler, and a helper that writes ``self`` state without
+either is flagged at its write site, attributed through the calling
+handler.  Helpers that are themselves remote handlers are scanned
+independently, not re-entered.  Remote handlers are methods named
+``handle_*`` whose parameter list includes ``sender_id`` or ``sender``
+— matching ``ConsensusProtocol.handle_message`` and the SyncKeyGen
+``handle_part``/``handle_ack`` family; ``handle_input`` (local input,
+trusted embedder) is deliberately out of scope.
 
 In the net/ harness scope the same discipline applies to the adversary
 hook surface (``tamper`` / ``pre_crank`` / ``on_send``): a tamper hook
@@ -153,6 +159,10 @@ class ByzantineInputRule(Rule):
         for node in ast.walk(mod.tree):
             if not isinstance(node, ast.ClassDef):
                 continue
+            methods = {
+                f.name: f for f in node.body if isinstance(f, ast.FunctionDef)
+            }
+            seen_helper_writes: set = set()
             for fn in node.body:
                 if not isinstance(fn, ast.FunctionDef):
                     continue
@@ -167,7 +177,11 @@ class ByzantineInputRule(Rule):
                 sender = _sender_param(fn)
                 if sender is None:
                     continue
-                findings.extend(self._check_handler(mod, node.name, fn, sender))
+                findings.extend(
+                    self._check_handler(
+                        mod, node.name, fn, sender, methods, seen_helper_writes
+                    )
+                )
         return findings
 
     def _check_submit(
@@ -253,8 +267,16 @@ class ByzantineInputRule(Rule):
         return findings
 
     def _check_handler(
-        self, mod: ModuleSource, cls: str, fn: ast.FunctionDef, sender: str
+        self,
+        mod: ModuleSource,
+        cls: str,
+        fn: ast.FunctionDef,
+        sender: str,
+        methods: Optional[dict] = None,
+        seen_helper_writes: Optional[set] = None,
     ) -> List[Finding]:
+        if seen_helper_writes is None:
+            seen_helper_writes = set()
         findings: List[Finding] = []
         for sub in self._escaping_raises(fn):
             findings.append(
@@ -270,10 +292,21 @@ class ByzantineInputRule(Rule):
 
         # Statement-ordered scan: first self-state write must be preceded
         # by a sender-membership check somewhere earlier in the body.
+        # Interprocedural (one level): a pre-check delegation that passes
+        # the sender into a same-class helper is followed — a helper that
+        # itself checks membership credits the handler; one that writes
+        # self state without a check is flagged at its write site.
         checked = False
         for stmt in self._linear_statements(fn):
             if not checked and _mentions_membership_check(stmt, sender):
                 checked = True
+            if not checked and methods is not None:
+                verdict = self._follow_delegations(
+                    mod, cls, fn, stmt, sender, methods,
+                    seen_helper_writes, findings,
+                )
+                if verdict:
+                    checked = True
             if _is_state_write(stmt) and not checked:
                 findings.append(
                     Finding(
@@ -287,6 +320,98 @@ class ByzantineInputRule(Rule):
                 )
                 break
         return findings
+
+    def _follow_delegations(
+        self, mod, cls, fn, stmt, sender, methods, seen, findings
+    ) -> bool:
+        """Scan ``stmt`` for same-class calls forwarding ``sender``; check
+        each target helper one level deep.  Returns True when some helper
+        performs the membership check (credits the caller)."""
+        credited = False
+        for sub in ast.walk(stmt):
+            if not isinstance(sub, ast.Call):
+                continue
+            func = sub.func
+            if not (
+                isinstance(func, ast.Attribute)
+                and isinstance(func.value, ast.Name)
+                and func.value.id == "self"
+            ):
+                continue
+            helper = methods.get(func.attr)
+            if helper is None or helper is fn:
+                continue
+            if helper.name.startswith("handle_") and _sender_param(helper):
+                continue  # a remote handler itself: scanned independently
+            mapped = self._mapped_param(sub, helper, sender)
+            if mapped is None:
+                continue
+            # Statement-ordered, like the handler scan itself: a helper
+            # write BEFORE the helper's check is still unguarded — the
+            # check must dominate the write on the linear path.
+            h_checked = False
+            for h_stmt in self._linear_statements(helper):
+                if not h_checked and (
+                    _mentions_membership_check(h_stmt, mapped)
+                    or self._validates_name(h_stmt, mapped)
+                ):
+                    h_checked = True
+                if _is_state_write(h_stmt) and not h_checked:
+                    key = (helper.name, h_stmt.lineno)
+                    if key not in seen:
+                        seen.add(key)
+                        findings.append(
+                            Finding(
+                                self.rule_id,
+                                mod.path,
+                                h_stmt.lineno,
+                                h_stmt.col_offset,
+                                f"{cls}.{helper.name} writes state on "
+                                f"sender-controlled input without checking "
+                                f"{mapped} membership (reached from "
+                                f"{cls}.{fn.name} before its own check)",
+                            )
+                        )
+                    break
+            if h_checked:
+                credited = True
+        return credited
+
+    @staticmethod
+    def _mapped_param(call: ast.Call, helper: ast.FunctionDef, sender: str):
+        """The helper parameter that receives the caller's ``sender``
+        argument, or None when the sender is not forwarded."""
+        params = [a.arg for a in helper.args.args]
+        if params and params[0] == "self":
+            params = params[1:]
+        for i, a in enumerate(call.args):
+            if isinstance(a, ast.Name) and a.id == sender and i < len(params):
+                return params[i]
+        for kw in call.keywords:
+            if (
+                isinstance(kw.value, ast.Name)
+                and kw.value.id == sender
+                and kw.arg in params
+            ):
+                return kw.arg
+        return None
+
+    @staticmethod
+    def _validates_name(stmt: ast.AST, name: str) -> bool:
+        """A ``*valid*``-named call receiving ``name`` — the dominating
+        validation call the interprocedural contract accepts."""
+        for sub in ast.walk(stmt):
+            if not isinstance(sub, ast.Call) or not _is_validation_call(sub):
+                continue
+            arg_names = {a.id for a in sub.args if isinstance(a, ast.Name)}
+            arg_names |= {
+                kw.value.id
+                for kw in sub.keywords
+                if isinstance(kw.value, ast.Name)
+            }
+            if name in arg_names:
+                return True
+        return False
 
     @classmethod
     def _escaping_raises(cls, node: ast.AST, in_try: bool = False):

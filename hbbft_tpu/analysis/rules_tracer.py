@@ -250,6 +250,12 @@ class DeferredFetchRule(Rule):
         "hbbft_tpu/ops/backend.py",
         "hbbft_tpu/parallel/backend.py",
         "hbbft_tpu/engine/",
+        # PR 9: the traffic driver and scenario harness hold engine hooks
+        # (batch_listeners / contribution_source / pre_crank) that run
+        # while pipeline dispatches may be in flight — a stray host fetch
+        # there re-serializes the overlap exactly like one in the engine
+        "hbbft_tpu/traffic/driver.py",
+        "hbbft_tpu/net/scenarios.py",
     )
 
     def check_module(self, mod: ModuleSource) -> List[Finding]:

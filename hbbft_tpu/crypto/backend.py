@@ -324,6 +324,14 @@ class MockBackend(CryptoBackend):
 
     #: chunk size for the simulated-async verify path (None = plain loop)
     pipeline_chunk: Optional[int] = None
+    #: schedule-explorer hook (analysis/schedules.py): ``resolve_order(k)
+    #: -> List[int]`` picks the resolution permutation of the k pending
+    #: chunks; None keeps the legacy deterministic last-submitted-first
+    resolve_order: Optional[Callable[[int], List[int]]] = None
+    #: per-chunk resolution listeners ``cb(lo, results)`` — fired from the
+    #: delivery callback (i.e. at RESOLVE time, mid-flush); the explorer's
+    #: seeded traffic mutation rides this
+    chunk_listeners: Sequence[Callable] = ()
 
     def __init__(self) -> None:
         super().__init__(MockGroup())
@@ -334,30 +342,44 @@ class MockBackend(CryptoBackend):
         self._pipe = DispatchPipeline(
             counters=None, tracer_ref=None, depth_fn=lambda: 1 << 30
         )
+        #: submission-order batch numbering for chunk identity (the
+        #: explorer's event keys; schedule-independent by construction)
+        self._batch_seq = 0
 
     def _piped_submit(self, items: Sequence, compute: Callable[[Sequence], List]):
         """Submit chunked deferred deliveries; returns (out, finish) where
         ``finish()`` resolves every pending chunk in a deterministic
-        OUT-OF-ORDER permutation (last-submitted-first) and returns
-        ``out`` fully populated."""
+        OUT-OF-ORDER permutation — last-submitted-first, or whatever the
+        ``resolve_order`` hook picks — and returns ``out`` populated."""
         step = self.pipeline_chunk or len(items) or 1
         out: List[Any] = [None] * len(items)
-        for lo in range(0, len(items), step):
+        b = self._batch_seq
+        self._batch_seq += 1
+        for ci, lo in enumerate(range(0, len(items), step)):
             chunk = items[lo : lo + step]
 
             def deliver(res, lo=lo):
                 out[lo : lo + len(res)] = res
+                for cb in self.chunk_listeners:
+                    cb(lo, res)
 
             self._pipe.submit(
                 lambda chunk=chunk: compute(chunk), fetch=None,
+                kind=f"b{b}.c{ci}", items=len(chunk),
                 on_result=deliver,
             )
 
         def finish():
-            self._pipe.flush(order=list(reversed(range(len(self._pipe)))))
+            self._pipe.flush(order=self._resolution_order())
             return out
 
         return out, finish
+
+    def _resolution_order(self) -> List[int]:
+        k = len(self._pipe)
+        if self.resolve_order is not None:
+            return self.resolve_order(k)
+        return list(reversed(range(k)))
 
     def _piped(self, items: Sequence, compute: Callable[[Sequence], List]) -> List:
         """Chunked deferred delivery with deterministic out-of-order

@@ -183,7 +183,13 @@ class VirtualNet:
     #: Environment, not state: whole-net snapshots drop it (the driver
     #: holds live callables) and restore falls back to None.
     traffic = None
-    _SNAPSHOT_ENV_ATTRS = ("traffic",)
+    #: schedule-explorer hooks (analysis/schedules.py) — environment, not
+    #: state.  With ``scheduler="controlled"``, ``crank_chooser(net)``
+    #: picks the queue index to deliver next; ``race_probe`` (a
+    #: RaceTracker) records crank events with causal enqueue edges.
+    crank_chooser = None
+    race_probe = None
+    _SNAPSHOT_ENV_ATTRS = ("traffic", "crank_chooser", "race_probe")
 
     def __init__(
         self,
@@ -320,7 +326,12 @@ class VirtualNet:
             raise self._crank_error(f"crank limit {self.crank_limit} exceeded")
 
         scheduler = self.adversary.scheduler_override or self.scheduler
-        idx = self.rng.randrange(len(self.queue)) if scheduler == "random" else 0
+        if scheduler == "controlled" and self.crank_chooser is not None:
+            idx = self.crank_chooser(self)
+        elif scheduler == "random":
+            idx = self.rng.randrange(len(self.queue))
+        else:
+            idx = 0
         msg = self.queue.pop(idx)
         node = self.nodes.get(msg.to)
         if node is None:
@@ -333,6 +344,9 @@ class VirtualNet:
             raise self._crank_error(
                 f"message limit {self.message_limit} exceeded"
             )
+        probe = self.race_probe
+        if probe is not None:
+            probe.begin_crank(msg)
         tr = self.tracer
         if tr is None:
             step = node.algorithm.handle_message(msg.sender, msg.payload, rng=self.rng)
@@ -360,6 +374,8 @@ class VirtualNet:
                 deferred=len(step.work),
             )
         self._process_step(node, step)
+        if probe is not None:
+            probe.end_crank()
         return msg.to, step
 
     def crank_round(self) -> int:
@@ -441,6 +457,9 @@ class VirtualNet:
         traffic is scheduled exactly like honest traffic.  Future-dated
         messages park on the time-ordered heap and enter ``queue`` only
         once deliverable."""
+        if self.race_probe is not None:
+            # stable content key + causal edge to the enqueuing crank
+            self.race_probe.tag_message(msg)
         if self.schedule is not None:
             delay = self.schedule.on_send(self, msg)
             if delay is None:
@@ -546,7 +565,7 @@ class NetBuilder:
         return self
 
     def scheduler(self, mode: str) -> "NetBuilder":
-        assert mode in ("random", "first")
+        assert mode in ("random", "first", "controlled")
         self._scheduler = mode
         return self
 

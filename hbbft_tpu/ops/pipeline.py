@@ -139,6 +139,11 @@ class DispatchPipeline:
         self._counters = counters
         self._tracer_ref = tracer_ref
         self._depth_fn = depth_fn
+        #: opt-in happens-before probe (analysis/schedules.RaceTracker):
+        #: when attached, every submit/resolve reports an event with a
+        #: vector clock, so a schedule divergence is reported as a
+        #: concrete racing access pair.  None costs one attribute check.
+        self.probe = None
         self._q: deque = deque()
         self._free_slots: List[int] = []
         self._slots_created = 0
@@ -153,6 +158,9 @@ class DispatchPipeline:
         return len(self._q)
 
     def _alloc_slot(self) -> int:
+        # lint: allow[seam-race] slot ids are recycled only after their
+        # dispatch resolved; reuse order affects tracer track NAMES only,
+        # never delivered values (callbacks write disjoint slots)
         if self._free_slots:
             return heapq.heappop(self._free_slots)
         s = self._slots_created
@@ -189,9 +197,14 @@ class DispatchPipeline:
         p = PendingDispatch(
             self, raw, fetch, kind, items, slot, on_result, t0, t_issued
         )
+        if self.probe is not None:
+            self.probe.pipe_submit(p)
         if depth <= 0:
             # Drain FIFO first so delivery order degenerates to program
             # order — byte-compatible with the pre-pipeline seam.
+            # lint: allow[seam-race] _q IS the pipeline API: the bounded
+            # FIFO handoff itself; entries are opaque PendingDispatch
+            # objects and every delivery writes only slots it owns
             while self._q:
                 self._q.popleft().resolve()
             self._resolve(p)
@@ -219,6 +232,8 @@ class DispatchPipeline:
         if p.done:
             return p.value
         p.done = True
+        if self.probe is not None:
+            self.probe.pipe_resolve(p)
         t_req = time.perf_counter()
         # fetch-block seconds other entries accrued inside THIS entry's
         # [t_issued, t_req] window — sampled before our own fetch adds in

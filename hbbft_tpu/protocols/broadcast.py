@@ -135,6 +135,9 @@ class Broadcast(ConsensusProtocol):
         our_idx = self.netinfo.node_index(self.netinfo.our_id)
         if not self._validate_proof(proof, our_idx):
             return Step.from_fault(self.proposer_id, "broadcast:invalid_value_proof")
+        # lint: allow[byzantine-input] the sender gate above is IDENTITY
+        # equality against the instance's proposer (only the proposer may
+        # send Value) — strictly stronger than set membership
         self.has_value = True
         self._value_proof = proof
         return self._send_echo(proof)

@@ -25,6 +25,7 @@ from hbbft_tpu.analysis.engine import (
 from hbbft_tpu.analysis.rules_byzantine import ByzantineInputRule
 from hbbft_tpu.analysis.rules_determinism import DeterminismRule
 from hbbft_tpu.analysis.rules_exhaustiveness import WIRE_PATH, HandlerExhaustivenessRule
+from hbbft_tpu.analysis.rules_seam import SeamRaceRule, seam_contexts_for_testing
 from hbbft_tpu.analysis.rules_tracer import TracerSafetyRule
 
 REPO_ROOT = Path(__file__).resolve().parent.parent
@@ -677,6 +678,7 @@ def test_all_rules_registered():
         "tracer-safety",
         "deferred-fetch",
         "glv-table-order",
+        "seam-race",
     }
 
 
@@ -951,3 +953,613 @@ def test_determinism_covers_adversary_and_scenarios():
         },
     )
     assert any("nondeterministic module 'random'" in f.message for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# Rule family 7: seam-race (PR 9 — submit/resolve boundary discipline)
+# ---------------------------------------------------------------------------
+
+SEAM_PATH = "hbbft_tpu/engine/_seeded.py"
+
+
+def test_seam_race_flags_submit_write_resolve_read():
+    findings = lint_sources(
+        SeamRaceRule(),
+        {
+            SEAM_PATH: """\
+            class Engine:
+                def __init__(self):
+                    self.acc = []
+
+                def _submit_chunk(self, pipe, chunk):
+                    self.acc.append(len(chunk))
+                    pipe.submit(chunk)
+
+                def _resolve(self, res):
+                    return list(self.acc)
+            """
+        },
+    )
+    assert len(findings) == 1
+    f = findings[0]
+    assert "self.acc is written on the submit path" in f.message
+    assert "read on the resolve path" in f.message
+    assert "Engine._resolve" in f.message
+
+
+def test_seam_race_flags_submit_read_of_resolve_written_state():
+    findings = lint_sources(
+        SeamRaceRule(),
+        {
+            SEAM_PATH: """\
+            class Engine:
+                def __init__(self):
+                    self.last = 0
+
+                def _submit_chunk(self, pipe, chunk):
+                    size = self.last + len(chunk)
+                    pipe.submit(chunk, items=size)
+
+                def _resolve(self, res):
+                    self.last = len(res)
+            """
+        },
+    )
+    assert len(findings) == 1
+    assert "self.last is read on the submit path" in findings[0].message
+    assert "written on the resolve path" in findings[0].message
+
+
+def test_seam_race_write_once_and_pipeline_api_are_clean():
+    findings = lint_sources(
+        SeamRaceRule(),
+        {
+            SEAM_PATH: """\
+            class Engine:
+                def __init__(self):
+                    self.cap = 8
+
+                def _submit_chunk(self, pipe, chunk, out, lo):
+                    def deliver(res):
+                        out[lo : lo + len(res)] = res
+
+                    pipe.submit(chunk[: self.cap], on_result=deliver)
+
+                def _resolve(self, res):
+                    return res[: self.cap]
+            """
+        },
+    )
+    # self.cap is read on both sides but never written outside __init__
+    # (write-once), and the delivered value rides the on_result plumbing
+    assert findings == []
+
+
+def test_seam_race_same_context_access_is_not_a_crossing():
+    findings = lint_sources(
+        SeamRaceRule(),
+        {
+            SEAM_PATH: """\
+            class Engine:
+                def __init__(self):
+                    self.n = 0
+
+                def flush(self, pipe):
+                    self.n += 1
+                    pipe.submit(self.n)
+                    pipe.flush()
+            """
+        },
+    )
+    # flush is tagged both submit (it submits) and resolve (its name);
+    # a write+read inside ONE function body is sequential, not a seam
+    assert findings == []
+
+
+def test_seam_race_respects_suppression():
+    findings = lint_sources(
+        SeamRaceRule(),
+        {
+            SEAM_PATH: """\
+            class Engine:
+                def __init__(self):
+                    self.acc = []
+
+                def _submit_chunk(self, pipe, chunk):
+                    # lint: allow[seam-race] sizing-only, never in verdicts
+                    self.acc.append(len(chunk))
+                    pipe.submit(chunk)
+
+                def _resolve(self, res):
+                    return list(self.acc)
+            """
+        },
+    )
+    assert findings == []
+
+
+def test_seam_race_out_of_scope_paths_ignored():
+    src = """\
+    class Engine:
+        def _submit_chunk(self, pipe, chunk):
+            self.acc.append(len(chunk))
+            pipe.submit(chunk)
+
+        def _resolve(self, res):
+            return list(self.acc)
+    """
+    assert lint_sources(
+        SeamRaceRule(), {"hbbft_tpu/protocols/broadcast2.py": src}
+    ) == []
+
+
+def test_seam_race_classifies_resolver_closures():
+    """Nested delivery callbacks and returned resolvers are resolve-path
+    contexts; the enclosing submit method stays submit-path."""
+    src = """\
+    class Engine:
+        def _submit_batch(self, pipe, items):
+            def deliver(res):
+                self.done = True
+
+            def finish():
+                return pipe.flush()
+
+            pipe.submit(items, on_result=deliver)
+            return finish
+    """
+    mod = ModuleSource(SEAM_PATH, textwrap.dedent(src))
+    tags = seam_contexts_for_testing(mod, "Engine")
+    assert tags["Engine._submit_batch"] == {"submit"}
+    assert "resolve" in tags["Engine._submit_batch.deliver"]
+    # finish is RETURNED from a submit-tagged method: a deferred resolver
+    assert "resolve" in tags["Engine._submit_batch.finish"]
+
+
+def test_seam_race_catches_counter_mutant_shape():
+    """The seeded ``counter`` mutant (analysis/mutations.py) is exactly
+    the source shape this rule exists for: mapped into the rule's scope,
+    its submit-path read of resolve-written state is flagged."""
+    src = (REPO_ROOT / "hbbft_tpu" / "analysis" / "mutations.py").read_text(
+        encoding="utf-8"
+    )
+    findings = lint_sources(
+        SeamRaceRule(), {"hbbft_tpu/ops/backend.py": src}
+    )
+    assert any("_last_resolved_lo" in f.message for f in findings), [
+        f.render() for f in findings
+    ]
+
+
+# ---------------------------------------------------------------------------
+# byzantine-input: interprocedural upgrade (PR 9 — one call level)
+# ---------------------------------------------------------------------------
+
+
+def test_byzantine_interprocedural_helper_write_flagged():
+    findings = lint_sources(
+        ByzantineInputRule(),
+        {
+            BYZ_PATH: """\
+            class P:
+                def handle_message(self, sender_id, payload):
+                    self._store(sender_id, payload)
+                    return None
+
+                def _store(self, sid, payload):
+                    self.states[sid] = payload
+            """
+        },
+    )
+    assert len(findings) == 1
+    f = findings[0]
+    assert "P._store writes state" in f.message
+    assert "sid membership" in f.message
+    assert "reached from P.handle_message" in f.message
+
+
+def test_byzantine_interprocedural_helper_check_credits_caller():
+    findings = lint_sources(
+        ByzantineInputRule(),
+        {
+            BYZ_PATH: """\
+            class P:
+                def handle_message(self, sender_id, payload):
+                    if not self._known(sender_id):
+                        return None
+                    self.states[sender_id] = payload
+                    return None
+
+                def _known(self, sid):
+                    return sid in self.validators
+            """
+        },
+    )
+    # _known is not a *membership-named* call, but its body performs the
+    # check on the forwarded parameter — the handler's own later write is
+    # credited through the delegation
+    assert findings == []
+
+
+def test_byzantine_interprocedural_validation_call_credits_caller():
+    findings = lint_sources(
+        ByzantineInputRule(),
+        {
+            BYZ_PATH: """\
+            class P:
+                def handle_message(self, sender_id, payload):
+                    self._admit(sender_id)
+                    self.states[sender_id] = payload
+                    return None
+
+                def _admit(self, sid):
+                    self._validate_peer(sid)
+            """
+        },
+    )
+    assert findings == []
+
+
+def test_byzantine_interprocedural_skips_remote_handler_helpers():
+    findings = lint_sources(
+        ByzantineInputRule(),
+        {
+            BYZ_PATH: """\
+            class P:
+                def handle_message(self, sender_id, payload):
+                    self.handle_part(sender_id, payload)
+                    return None
+
+                def handle_part(self, sender_id, part):
+                    if self.netinfo.is_validator(sender_id):
+                        self.parts[sender_id] = part
+                    return None
+            """
+        },
+    )
+    # handle_part is itself a remote handler: scanned independently (and
+    # clean), never re-entered through the delegation pass
+    assert findings == []
+
+
+def test_byzantine_interprocedural_dedups_shared_helper():
+    findings = lint_sources(
+        ByzantineInputRule(),
+        {
+            BYZ_PATH: """\
+            class P:
+                def handle_message(self, sender_id, payload):
+                    self._store(sender_id, payload)
+                    return None
+
+                def handle_part(self, sender_id, part):
+                    self._store(sender_id, part)
+                    return None
+
+                def _store(self, sid, payload):
+                    self.states[sid] = payload
+            """
+        },
+    )
+    # two handlers reach the same unguarded helper write: one finding
+    # per write site, not one per caller
+    assert len(findings) == 1
+
+
+# ---------------------------------------------------------------------------
+# deferred-fetch scope: traffic driver + scenario harness (PR 9)
+# ---------------------------------------------------------------------------
+
+
+def test_deferred_fetch_covers_traffic_and_scenario_hooks():
+    from hbbft_tpu.analysis.rules_tracer import DeferredFetchRule
+
+    src = """\
+    import numpy as np
+
+    def peek_inflight(out):
+        return np.asarray(out)
+    """
+    rule = DeferredFetchRule()
+    for path in ("hbbft_tpu/traffic/driver.py", "hbbft_tpu/net/scenarios.py"):
+        assert rule.applies_to(path)
+        findings = lint_sources(DeferredFetchRule(), {path: src})
+        assert len(findings) == 1, path
+        assert "np.asarray" in findings[0].message
+
+
+# ---------------------------------------------------------------------------
+# stale-suppression + baseline pruning (PR 9)
+# ---------------------------------------------------------------------------
+
+
+def _write_module(tmp_path, rel, src):
+    p = tmp_path / rel
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(textwrap.dedent(src), encoding="utf-8")
+    return p
+
+
+def test_stale_suppression_flags_dead_allow(tmp_path):
+    p = _write_module(
+        tmp_path,
+        "hbbft_tpu/protocols/x.py",
+        """\
+        x = 1  # lint: allow[determinism] nothing here is nondeterministic
+        """,
+    )
+    findings = run_lint(tmp_path, [p])
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.rule == "stale-suppression"
+    assert f.line == 1
+    assert "allow[determinism]" in f.message
+    assert "matches no finding" in f.message
+
+
+def test_stale_suppression_quiet_for_live_allow(tmp_path):
+    p = _write_module(
+        tmp_path,
+        "hbbft_tpu/protocols/x.py",
+        """\
+        import time  # lint: allow[determinism] fixture: import is justified
+
+
+        def emit(self):
+            now = time.time()  # lint: allow[determinism] fixture: justified
+            return now
+        """,
+    )
+    findings = run_lint(tmp_path, [p])
+    assert findings == [], [f.render() for f in findings]
+
+
+def test_stale_suppression_checks_comment_line_binding(tmp_path):
+    """A comment-line allow binds to the next source line (skipping the
+    rest of the justification comment); fired suppressions are live."""
+    p = _write_module(
+        tmp_path,
+        "hbbft_tpu/protocols/x.py",
+        """\
+        import time  # lint: allow[determinism] fixture: import is justified
+
+
+        def emit(self):
+            # lint: allow[determinism] fixture: wall clock is justified
+            # (a second comment line continues the justification)
+            now = time.time()
+            return now
+        """,
+    )
+    findings = run_lint(tmp_path, [p])
+    assert findings == [], [f.render() for f in findings]
+
+
+def test_stale_suppression_not_reported_on_subset_runs(tmp_path):
+    """A single-rule run cannot tell dead from not-exercised: the stale
+    pass only runs with the full rule set."""
+    p = _write_module(
+        tmp_path,
+        "hbbft_tpu/protocols/x.py",
+        """\
+        x = 1  # lint: allow[tracer-safety] out-of-scope fixture allow
+        """,
+    )
+    findings = run_lint(tmp_path, [p], rules=[DeterminismRule()])
+    assert findings == []
+
+
+def test_baseline_rewrite_prunes_vanished_entries(tmp_path):
+    """--baseline prunes grandfathered entries whose findings no longer
+    occur and reports the pruned count."""
+    import json as _json
+    import subprocess
+    import sys
+
+    bl = tmp_path / "baseline.json"
+    bl.write_text(
+        _json.dumps(
+            {
+                "version": 1,
+                "findings": [
+                    {
+                        "rule": "determinism",
+                        "path": "hbbft_tpu/_gone.py",
+                        "message": "finding that no longer occurs",
+                        "count": 3,
+                    }
+                ],
+            }
+        ),
+        encoding="utf-8",
+    )
+    proc = subprocess.run(
+        [
+            sys.executable,
+            "tools/lint.py",
+            "--baseline",
+            "--baseline-file",
+            str(bl),
+        ],
+        cwd=REPO_ROOT,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "3 pruned" in proc.stdout
+    data = _json.loads(bl.read_text(encoding="utf-8"))
+    assert all(e["path"] != "hbbft_tpu/_gone.py" for e in data["findings"])
+
+
+def test_byzantine_interprocedural_write_before_check_in_helper_flagged():
+    """The credit is statement-ordered inside the helper too: a write
+    that precedes the helper's own membership check is still unguarded
+    (refactoring write-then-check into a helper must not pass)."""
+    findings = lint_sources(
+        ByzantineInputRule(),
+        {
+            BYZ_PATH: """\
+            class P:
+                def handle_message(self, sender_id, payload):
+                    self._store(sender_id, payload)
+                    return None
+
+                def _store(self, sid, payload):
+                    self.states[sid] = payload
+                    if sid in self.validators:
+                        self.seen.add(sid)
+            """
+        },
+    )
+    assert len(findings) == 1
+    assert "P._store writes state" in findings[0].message
+
+
+def test_stale_suppression_rule_keyed_against_same_line_allows(tmp_path):
+    """A dead allow does not hide behind a DIFFERENT rule's live allow
+    on the same target line."""
+    p = _write_module(
+        tmp_path,
+        "hbbft_tpu/protocols/x.py",
+        """\
+        # lint: allow[tracer-safety] fixture: never fires in this scope
+        import time  # lint: allow[determinism] fixture: import justified
+
+
+        def emit(self):
+            now = time.time()  # lint: allow[determinism] fixture: justified
+            return now
+        """,
+    )
+    findings = run_lint(tmp_path, [p])
+    assert len(findings) == 1
+    assert findings[0].rule == "stale-suppression"
+    assert findings[0].line == 1
+    assert "allow[tracer-safety]" in findings[0].message
+
+
+def test_stale_suppression_escape_hatch_converges(tmp_path):
+    """A deliberately kept dead allow is silenced with
+    allow[stale-suppression], and the silencing comment is itself
+    counted as live — the escape hatch terminates."""
+    p = _write_module(
+        tmp_path,
+        "hbbft_tpu/protocols/x.py",
+        """\
+        # lint: allow[stale-suppression] fixture: kept for a pending PR
+        x = 1  # lint: allow[determinism] fixture: dead but kept
+        """,
+    )
+    findings = run_lint(tmp_path, [p])
+    assert findings == [], [f.render() for f in findings]
+
+
+def test_comment_allow_binding_stops_at_blank_lines(tmp_path):
+    """A comment-only allow binds across continuation COMMENT lines but
+    not across a blank line — a dead allow above a blank line must not
+    capture (and silently suppress) the next code block."""
+    p = _write_module(
+        tmp_path,
+        "hbbft_tpu/protocols/x.py",
+        """\
+        # lint: allow[determinism] justification for since-deleted code
+
+        import time
+        """,
+    )
+    findings = run_lint(tmp_path, [p])
+    rules = sorted(f.rule for f in findings)
+    # the genuine violation IS reported, and the allow is reported stale
+    assert "determinism" in rules
+    assert "stale-suppression" in rules
+
+
+def test_stale_suppression_escape_hatch_for_comment_only_allow(tmp_path):
+    """The hatch also silences a kept COMMENT-ONLY dead allow: the
+    allow[stale-suppression] comment above it binds to the same code
+    line, and both comments count as live."""
+    p = _write_module(
+        tmp_path,
+        "hbbft_tpu/protocols/x.py",
+        """\
+        # lint: allow[stale-suppression] fixture: kept for a pending PR
+        # lint: allow[determinism] fixture: dead but deliberately kept
+        x = 1
+        """,
+    )
+    findings = run_lint(tmp_path, [p])
+    assert findings == [], [f.render() for f in findings]
+
+
+def test_lone_stale_suppression_allow_is_itself_stale(tmp_path):
+    """An allow[stale-suppression] protecting nothing is dead code."""
+    p = _write_module(
+        tmp_path,
+        "hbbft_tpu/protocols/x.py",
+        """\
+        # lint: allow[stale-suppression] fixture: protects nothing
+        x = 1
+        """,
+    )
+    findings = run_lint(tmp_path, [p])
+    assert len(findings) == 1
+    assert findings[0].rule == "stale-suppression"
+    assert "allow[stale-suppression]" in findings[0].message
+
+
+def test_dataflow_doubly_nested_defs_summarized_once():
+    """A grandchild def belongs to its DIRECT parent's summary only —
+    double-summarizing would give one closure two contexts with
+    different seam tags."""
+    from hbbft_tpu.analysis.dataflow import summarize_module
+
+    src = """\
+    class C:
+        def outer(self):
+            def h():
+                def g2():
+                    return self.x
+
+                return g2
+
+            return h
+    """
+    mod = ModuleSource(SEAM_PATH, textwrap.dedent(src))
+    cls = summarize_module(mod).classes["C"]
+    outer = cls.methods["outer"]
+    assert set(outer.nested) == {"h"}
+    assert set(outer.nested["h"].nested) == {"g2"}
+
+
+def test_seam_race_positional_submit_closure_stays_submit_path():
+    """submit()'s first positional argument is the launch thunk — it
+    runs synchronously at submit time, so a named def passed there is
+    NOT a resolver (only on_result=/fetch= closures are)."""
+    src = """\
+    class Engine:
+        def _submit_chunk(self, pipe, chunk):
+            def launch():
+                return self.staged
+
+            pipe.submit(launch)
+    """
+    mod = ModuleSource(SEAM_PATH, textwrap.dedent(src))
+    tags = seam_contexts_for_testing(mod, "Engine")
+    assert tags["Engine._submit_chunk.launch"] == {"submit"}
+
+
+def test_no_wildcard_allow_form(tmp_path):
+    """There is deliberately no blanket allow[*]: it would self-suppress
+    its own stale-suppression finding, making dead blankets
+    undetectable.  The form does not parse as a suppression at all."""
+    p = _write_module(
+        tmp_path,
+        "hbbft_tpu/protocols/x.py",
+        """\
+        import time  # lint: allow[*] not a recognized suppression form
+        """,
+    )
+    findings = run_lint(tmp_path, [p])
+    assert any(f.rule == "determinism" for f in findings)
+    assert all(f.rule != "stale-suppression" for f in findings)

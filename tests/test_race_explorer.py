@@ -1,0 +1,315 @@
+"""Schedule-space race explorer: sensitivity fixtures + replay contract.
+
+Tier-1 runs the smoke sweep (all three honest seams agree across every
+explored schedule) and pins the detector's sensitivity: each seeded
+order-dependent mutant in ``analysis/mutations.py`` must be caught with
+a minimized counterexample that replays to the identical divergence in a
+fresh process (``tools/race_explorer.py --replay``).  The slow arm runs
+the full N∈{4,7} sweep (≥1000 non-equivalent schedules, DPOR-reduced).
+"""
+
+import json
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+from hbbft_tpu.analysis import schedules
+from hbbft_tpu.analysis.mutations import MUTANT_NAMES
+from hbbft_tpu.analysis.schedules import (
+    Event,
+    RaceTracker,
+    ScheduleController,
+    clocks_concurrent,
+    events_dependent,
+)
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+EXPLORER = REPO_ROOT / "tools" / "race_explorer.py"
+
+
+def _cli(*args, **kw):
+    return subprocess.run(
+        [sys.executable, str(EXPLORER), *args],
+        cwd=REPO_ROOT,
+        capture_output=True,
+        text=True,
+        timeout=120,
+        **kw,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Controller / trace machinery
+# ---------------------------------------------------------------------------
+
+
+def test_controller_default_schedule_is_all_zeros():
+    c = ScheduleController()
+    assert c.choose(3, "x") == 0
+    assert c.choose(1, "degenerate") == 0  # arity-1: not recorded
+    assert c.permutation(3, "p") == [0, 1, 2]
+    # only the arity>1 decisions were recorded
+    assert c.trace == [0, 0, 0]
+
+
+def test_controller_replays_preset_choices():
+    c = ScheduleController([2, 1])
+    assert c.choose(3, "x") == 2
+    assert c.permutation(3, "p") == [1, 0, 2]  # picks idx 1, then defaults
+    # a fresh controller with the recorded trace reproduces the run
+    c2 = ScheduleController(list(c.trace))
+    assert c2.choose(3, "x") == 2
+    assert c2.permutation(3, "p") == [1, 0, 2]
+    assert c2.trace == c.trace
+
+
+def test_controller_preset_wraps_modulo_arity():
+    c = ScheduleController([7])
+    assert c.choose(3, "x") == 1  # 7 % 3 — mutated presets stay in range
+
+
+# ---------------------------------------------------------------------------
+# Vector clocks / dependence
+# ---------------------------------------------------------------------------
+
+
+def test_vector_clocks_order_causal_chains_and_expose_races():
+    t = RaceTracker()
+    a = t.record("submit:b0.c0", "main", "submit")
+    b = t.record(
+        "resolve:b0.c0", "chunk:0", "resolve",
+        writes=(("batch", "b0"),), causes=(a.index,),
+    )
+    c = t.record(
+        "resolve:b0.c1", "chunk:1", "resolve", writes=(("batch", "b0"),)
+    )
+    # submit happens-before its own resolve (causal edge joins clocks)
+    assert not clocks_concurrent(a, b)
+    # the two chunk resolutions are causally unordered AND conflict on
+    # the batch object: exactly one racing pair
+    assert clocks_concurrent(b, c)
+    assert ("resolve:b0.c0", "resolve:b0.c1") in t.racing_pairs()
+
+
+def test_canonical_form_is_order_free_for_independent_events():
+    def build(order):
+        t = RaceTracker()
+        evs = {
+            "x": ("node:1", (("node", "1"),)),
+            "y": ("node:2", (("node", "2"),)),
+        }
+        for k in order:
+            task, writes = evs[k]
+            t.record(k, task, "crank", writes=writes)
+        return t
+
+    assert build("xy").canonical_form() == build("yx").canonical_form()
+
+
+def test_canonical_form_distinguishes_dependent_orders():
+    def build(order):
+        t = RaceTracker()
+        for k in order:
+            t.record(k, f"task:{k}", "resolve", writes=(("batch", "b0"),))
+        return t
+
+    assert build("xy").canonical_form() != build("yx").canonical_form()
+
+
+def test_events_dependent_same_task_and_footprint():
+    e1 = Event(0, "a", "t1", "crank", frozenset({("n", 1)}), frozenset(), ())
+    e2 = Event(1, "b", "t1", "crank", frozenset(), frozenset(), ())
+    e3 = Event(2, "c", "t2", "crank", frozenset(), frozenset({("n", 1)}), ())
+    e4 = Event(3, "d", "t3", "crank", frozenset({("m", 9)}), frozenset(), ())
+    assert events_dependent(e1, e2)  # same task
+    assert events_dependent(e1, e3)  # write/read conflict
+    assert not events_dependent(e1, e4)  # disjoint everything
+
+
+# ---------------------------------------------------------------------------
+# Honest seams: smoke sweep agrees (tier-1 subset of the full sweep)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("target,n,max_runs", [
+    ("pipeline", 4, 30),
+    ("traffic", 4, 20),
+    ("virtualnet", 4, 40),
+])
+def test_smoke_sweep_schedule_independent(target, n, max_runs):
+    ex = schedules.explore(target, n, seed=0, max_runs=max_runs)
+    assert ex.ok, f"divergence on honest target {target}: {ex.divergence}"
+    assert ex.runs > 1, "explorer never left the default schedule"
+    assert ex.classes >= 2, "no schedule freedom explored"
+
+
+def test_dpor_prunes_commuting_deliveries():
+    # deliveries to different nodes without a causal edge commute: the
+    # virtualnet target must prune a large share of the naive branches
+    ex = schedules.explore("virtualnet", 4, seed=0, max_runs=40)
+    assert ex.ok
+    assert ex.pruned > 0, "DPOR reduction inactive"
+    # and equivalence classes stay well below executed runs
+    assert ex.classes < ex.runs + ex.pruned
+
+
+def test_explorer_counts_equivalent_revisits_once():
+    ex = schedules.explore("virtualnet", 4, seed=0, max_runs=40)
+    # classes + revisits == runs (every executed run lands in a class)
+    assert ex.classes + ex.revisits == ex.runs
+
+
+# ---------------------------------------------------------------------------
+# Seeded mutants: the detector's sensitivity fixtures
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("mutant", MUTANT_NAMES)
+def test_mutant_detected_with_minimized_counterexample(mutant, tmp_path):
+    ex = schedules.explore(f"mutant:{mutant}", 4, seed=0, max_runs=60)
+    assert not ex.ok, f"explorer went blind to mutant {mutant}"
+    div = ex.divergence
+    # minimized: non-empty, no trailing default choices
+    assert div["choices"], "empty counterexample cannot diverge"
+    assert div["choices"][-1] != 0
+    assert div["first_divergence"]["index"] is not None
+    # the counterexample file replays in-process to the same divergence
+    cx = tmp_path / f"{mutant}.json"
+    schedules.write_counterexample(cx, ex)
+    rep = schedules.replay_counterexample(cx)
+    assert rep["diverged"]
+    assert rep["reproduced"], rep
+
+
+def test_counter_mutant_reports_racing_pair():
+    # the vector-clock probe names the schedule-sensitive state: the
+    # divergent run must expose at least one concurrent conflicting pair
+    ex = schedules.explore("mutant:counter", 4, seed=0, max_runs=60)
+    assert not ex.ok
+    assert ex.divergence["racing"], "no racing pair reported"
+
+
+def test_replay_reproduces_in_fresh_process(tmp_path):
+    """The counterexample written by one process re-runs to the identical
+    divergence (fingerprint pair + first divergent event) in another."""
+    ex = schedules.explore("mutant:accum", 4, seed=0, max_runs=60)
+    assert not ex.ok
+    cx = tmp_path / "cx.json"
+    schedules.write_counterexample(cx, ex)
+    proc = _cli("--replay", str(cx), "--json")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    rep = json.loads(proc.stdout)
+    assert rep["reproduced"] is True
+    assert rep["first_divergence"] == ex.divergence["first_divergence"]
+
+
+def test_replay_detects_non_reproduction(tmp_path):
+    ex = schedules.explore("mutant:accum", 4, seed=0, max_runs=60)
+    cx = tmp_path / "cx.json"
+    schedules.write_counterexample(cx, ex)
+    doc = json.loads(cx.read_text())
+    doc["choices"] = []  # tampered: the default schedule cannot diverge
+    cx.write_text(json.dumps(doc))
+    proc = _cli("--replay", str(cx))
+    assert proc.returncode == 2, proc.stdout + proc.stderr
+
+
+def test_cli_mutant_exit_code_and_counterexample(tmp_path):
+    cx = tmp_path / "cx.json"
+    proc = _cli(
+        "--target", "mutant:listener", "--n", "4", "--max-runs", "60",
+        "--counterexample", str(cx),
+    )
+    assert proc.returncode == 1
+    assert cx.exists()
+    doc = json.loads(cx.read_text())
+    assert doc["target"] == "mutant:listener"
+    assert doc["reference_parts"] != doc["divergent_parts"]
+
+
+# ---------------------------------------------------------------------------
+# Determinism of the machinery itself
+# ---------------------------------------------------------------------------
+
+
+def test_run_schedule_fingerprints_are_deterministic():
+    a = schedules.run_schedule("pipeline", 4, 0, [])
+    b = schedules.run_schedule("pipeline", 4, 0, [])
+    assert a.parts == b.parts
+    assert a.fingerprint == b.fingerprint
+    assert a.canonical == b.canonical
+    # a different seed is a different reference (the fingerprint is real)
+    c = schedules.run_schedule("pipeline", 4, 1, [])
+    assert a.parts != c.parts
+
+
+def test_fingerprint_includes_the_contracted_parts():
+    r = schedules.run_schedule("pipeline", 4, 0, [])
+    assert set(r.parts) >= {
+        "batches_sha", "faults", "counters", "device_dispatches", "error"
+    }
+    assert r.parts["error"] == ""
+    assert r.parts["device_dispatches"] >= 0
+
+
+# ---------------------------------------------------------------------------
+# CI entry point: one command, deterministic, under budget
+# ---------------------------------------------------------------------------
+
+
+def test_ci_entry_point_runs_clean_and_under_budget():
+    """``tools/ci.sh`` (lint --ci + explorer smoke) exits 0 on the
+    current tree, prints deterministic stage output, and stays well
+    inside the tier-1 budget (the smoke sweep alone must be ≤30 s)."""
+    t0 = time.monotonic()
+    proc = subprocess.run(
+        ["bash", str(REPO_ROOT / "tools" / "ci.sh")],
+        cwd=REPO_ROOT,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    wall = time.monotonic() - t0
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "lint: 0 new finding(s)" in proc.stdout
+    assert "ok=True" in proc.stdout
+    assert proc.stdout.strip().endswith("ci: ok")
+    assert wall < 60.0, f"ci.sh took {wall:.1f}s"
+    # deterministic output: a second run prints the identical transcript
+    proc2 = subprocess.run(
+        ["bash", str(REPO_ROOT / "tools" / "ci.sh")],
+        cwd=REPO_ROOT,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc2.stdout == proc.stdout
+
+
+def test_explorer_smoke_cli_under_30s():
+    t0 = time.monotonic()
+    proc = _cli("--smoke")
+    wall = time.monotonic() - t0
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert wall < 30.0, f"smoke sweep took {wall:.1f}s"
+
+
+# ---------------------------------------------------------------------------
+# Slow arm: the full sweep (the acceptance bar lives here)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_full_sweep_explores_1000_schedules_and_agrees():
+    # the CLI's --full and this acceptance bar share schedules.FULL_PLAN
+    t0 = time.monotonic()
+    total_classes = 0
+    for target, n, max_runs in schedules.FULL_PLAN:
+        ex = schedules.explore(target, n, seed=0, max_runs=max_runs)
+        assert ex.ok, f"{target} n={n}: {ex.divergence}"
+        total_classes += ex.classes
+    assert total_classes >= 1000
+    assert time.monotonic() - t0 < 300

@@ -1,0 +1,40 @@
+#!/usr/bin/env bash
+# Single CI entry point for the static + concurrency gates (PR 9).
+#
+#   bash tools/ci.sh
+#
+# Runs, in order:
+#   1. tools/lint.py --ci   — the custom AST rule families (seam-race,
+#      byzantine-input, determinism, handler-exhaustiveness,
+#      tracer-safety, deferred-fetch, glv-table-order, plus the
+#      stale-suppression pass) against tools/lint_baseline.json, and
+#      ruff when the binary is installed (skipped cleanly otherwise —
+#      no dependency is downloaded).
+#   2. tools/race_explorer.py --smoke — the schedule-space smoke sweep
+#      over the pipeline / traffic-hook / virtualnet seams.
+#
+# Output is deterministic (lint findings are sorted; the explorer's
+# run/class/prune counts are seeded), so CI diffs are meaningful.  Exit
+# status is nonzero iff any stage found a new finding or a schedule
+# divergence.  Budget: the whole script is a few seconds on one CPU
+# core (no JAX import on any path) — tests/test_race_explorer.py pins
+# it under 60 s in tier-1.
+
+set -u -o pipefail
+cd "$(dirname "$0")/.."
+
+PY="${PYTHON:-python}"
+rc=0
+
+echo "== ci: lint (custom rule families + ruff if installed) =="
+"$PY" tools/lint.py --ci || rc=1
+
+echo "== ci: schedule-space race explorer (smoke sweep) =="
+"$PY" tools/race_explorer.py --smoke || rc=1
+
+if [ "$rc" -ne 0 ]; then
+    echo "ci: FAILED"
+else
+    echo "ci: ok"
+fi
+exit "$rc"

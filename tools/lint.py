@@ -112,10 +112,23 @@ def main(argv=None) -> int:
         if args.diff or args.files:
             print("lint: --baseline requires a full run", file=sys.stderr)
             return 2
-        Baseline.from_findings(findings).save(args.baseline_file)
+        old = Baseline.load(args.baseline_file)
+        new_baseline = Baseline.from_findings(findings)
+        # prune report: grandfathered entries whose findings no longer
+        # occur (fixed code keeps the baseline honest automatically)
+        pruned = sum(
+            max(0, n - new_baseline.counts.get(key, 0))
+            for key, n in old.counts.items()
+        )
+        added = sum(
+            max(0, n - old.counts.get(key, 0))
+            for key, n in new_baseline.counts.items()
+        )
+        new_baseline.save(args.baseline_file)
         print(
             f"lint: baseline rewritten with {len(findings)} grandfathered "
-            f"finding(s) -> {args.baseline_file}"
+            f"finding(s) -> {args.baseline_file} "
+            f"({pruned} pruned, {added} added)"
         )
         return 0
 
