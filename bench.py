@@ -692,6 +692,87 @@ def bench_scenario_matrix() -> dict:
     }
 
 
+def bench_crash_matrix() -> dict:
+    """The composed gauntlet (net/scenarios.py Cell runner): attack ×
+    net-schedule × churn × crash+restart × traffic soaks with the full
+    verdict set — honest Batches bit-identical, every fault attributed,
+    restarted nodes recommitted within the gate, stable seeded-replay
+    fingerprints.  The ``fault_kinds`` aggregate (including the crash:*
+    recovery kinds when a recovery fails) feeds tools/trace_report.py
+    --faults.  Knobs: BENCH_CRASH_N / BENCH_CRASH_EPOCHS / BENCH_CRASH_CELLS
+    (cell specs, comma-separated) / BENCH_CRASH_BACKEND (mock|cpu|tpu:
+    real crypto routes the restored node's replay re-verifies through
+    the device)."""
+    from examples.simulation import make_backend
+    from hbbft_tpu.net.scenarios import Cell, run_cell
+
+    backend_name = os.environ.get("BENCH_CRASH_BACKEND", "mock")
+    n = int(os.environ.get("BENCH_CRASH_N", "5"))
+    epochs = int(os.environ.get("BENCH_CRASH_EPOCHS", "12"))
+    specs = os.environ.get(
+        "BENCH_CRASH_CELLS",
+        "equivocate:partition_heal:era_flip:one_restart:one_x,"
+        "crafted_shares:wan:era_flip:two_restarts:two_x,"
+        "replay_flood:lan:none:one_restart:half_x",
+    ).split(",")
+    t0 = time.perf_counter()
+    results = []
+    for spec in specs:
+        parts = (spec.split(":") + ["none"] * 5)[:5]
+        cell = Cell(
+            attack=parts[0], schedule=parts[1], churn=parts[2],
+            crash=parts[3], traffic=parts[4], n=n, epochs=epochs, seed=1,
+        )
+        results.append(run_cell(cell, backend=make_backend(backend_name)))
+    dt = time.perf_counter() - t0
+    n_ok = sum(1 for r in results if r.ok)
+    fault_kinds: dict = {}
+    recovery_cranks = []
+    for r in results:
+        for kind, cnt in r.fault_kinds.items():
+            fault_kinds[kind] = fault_kinds.get(kind, 0) + cnt
+        recovery_cranks.extend(
+            rec.get("down_cranks", 0) for rec in r.recoveries
+        )
+    failed = [
+        {"cell": r.cell.cell_id(), "error": r.error,
+         "missing": r.missing_expected, "misattributed": r.misattributed[:4]}
+        for r in results
+        if not r.ok
+    ]
+    return {
+        "metric": "crash_matrix",
+        "value": round(len(results) / dt, 2),
+        "unit": "cells/s",
+        "vs_baseline": 1.0,
+        "baseline": "estimated",
+        "cells": len(results),
+        "cells_ok": n_ok,
+        "all_ok": n_ok == len(results),
+        "crashes": sum(r.crashes for r in results),
+        "restarts": sum(r.restarts for r in results),
+        "recovered_in_time": all(r.recovered_in_time for r in results),
+        "recovery_cranks": _histogram_summary(recovery_cranks),
+        "tx_committed": sum(r.tx_committed for r in results),
+        "commit_p99_max": max((r.commit_p99 for r in results), default=0.0),
+        "fault_kinds": dict(sorted(fault_kinds.items())),
+        "failed_cells": failed,
+        "backend": backend_name,
+    }
+
+
+def _histogram_summary(values: list) -> dict:
+    if not values:
+        return {"count": 0}
+    s = sorted(values)
+    return {
+        "count": len(s),
+        "min": s[0],
+        "max": s[-1],
+        "mean": round(sum(s) / len(s), 1),
+    }
+
+
 def bench_qhb_traffic() -> dict:
     """The QueueingHoneyBadger batch-size × arrival-rate curve — the
     traffic subsystem's bench row (hbbft_tpu/traffic/): open-loop Poisson
@@ -1840,7 +1921,7 @@ _BENCH_EST_S = {
     "fq_kernel": 240, "n4": 60, "n4_realcrypto": 300, "n100": 420,
     "array_n256_soak": 300, "array_n100_dedup": 120, "array_n64_coin": 240,
     "array_n100": 300, "glv_ladder": 180, "adv_matrix": 600,
-    "scenario_matrix": 60, "qhb_traffic": 420,
+    "scenario_matrix": 60, "qhb_traffic": 420, "crash_matrix": 120,
 }
 
 
@@ -1881,6 +1962,7 @@ def _plan_benches(only, platform: str, budget: float) -> list:
         # diagnostic A/B row — after the flagship prefix, before support
         plan.append(("glv_ladder", bench_glv_ladder))
         plan.append(("scenario_matrix", bench_scenario_matrix))
+        plan.append(("crash_matrix", bench_crash_matrix))
         # traffic curve: new measured axis, ahead of the support rows
         plan.append(("qhb_traffic", bench_qhb_traffic))
         plan += [("rs_encode", bench_rs_encode), ("rs_host", bench_rs_host)]
@@ -1921,6 +2003,7 @@ def _plan_benches(only, platform: str, budget: float) -> list:
             ("rlc_dec_adversarial", bench_rlc_dec_adversarial),
             ("adv_matrix", bench_adv_matrix),
             ("scenario_matrix", bench_scenario_matrix),
+            ("crash_matrix", bench_crash_matrix),
             ("qhb_traffic", bench_qhb_traffic),
             ("glv_ladder", bench_glv_ladder),
         ]

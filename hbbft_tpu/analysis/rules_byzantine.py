@@ -127,9 +127,26 @@ def _mentions_membership_check(node: ast.AST, sender: str) -> bool:
     return False
 
 
-#: adversary/scenario hook surface checked in the net/ scope
-_HOOK_NAMES = ("tamper", "pre_crank", "on_send")
-_NET_SCOPE = ("hbbft_tpu/net/adversary.py", "hbbft_tpu/net/scenarios.py")
+#: adversary/scenario/crash hook surface checked in the net/ scope —
+#: the crash axis's crank hooks (net/crash.py) carry the same contract:
+#: a recovery failure becomes an attributed fault, never an exception
+#: out of the crank loop
+_HOOK_NAMES = (
+    "tamper",
+    "pre_crank",
+    "on_send",
+    "on_crank",
+    "on_idle",
+    "on_deliver",
+    "on_input",
+    "on_enqueue",
+    "after_crank",
+)
+_NET_SCOPE = (
+    "hbbft_tpu/net/adversary.py",
+    "hbbft_tpu/net/scenarios.py",
+    "hbbft_tpu/net/crash.py",
+)
 #: client-facing admission surface checked in the traffic scope
 _TRAFFIC_SCOPE = "hbbft_tpu/traffic/"
 

@@ -134,6 +134,7 @@ class DeterminismRule(Rule):
         "hbbft_tpu/core/",
         "hbbft_tpu/net/adversary.py",
         "hbbft_tpu/net/scenarios.py",
+        "hbbft_tpu/net/crash.py",
         "hbbft_tpu/traffic/",
     )
 

@@ -55,6 +55,9 @@ FAULT_KIND_RE = re.compile(r"^[a-z][a-z_0-9]*:[a-z][a-z_0-9]*$")
 FAULT_PREFIX_MODULES: Dict[str, str] = {
     "binary_agreement": "hbbft_tpu/protocols/binary_agreement.py",
     "broadcast": "hbbft_tpu/protocols/broadcast.py",
+    # the crash/restart axis emits outside protocols/ — the emitted-kind
+    # scan below covers every owner module, wherever it lives
+    "crash": "hbbft_tpu/net/crash.py",
     "dynamic_honey_badger": "hbbft_tpu/protocols/dynamic_honey_badger.py",
     "honey_badger": "hbbft_tpu/protocols/honey_badger.py",
     "sbv": "hbbft_tpu/protocols/sbv_broadcast.py",
@@ -324,8 +327,12 @@ class HandlerExhaustivenessRule(Rule):
 
         # every emitted literal must be registered
         emitted: Dict[str, Set[str]] = {}  # kind -> modules emitting it
+        emitter_paths = set(FAULT_PREFIX_MODULES.values())
         for path in sorted(project.modules):
-            if not path.startswith("hbbft_tpu/protocols/"):
+            if (
+                not path.startswith("hbbft_tpu/protocols/")
+                and path not in emitter_paths
+            ):
                 continue
             mod = project.modules[path]
             for kind, line in sorted(_fault_kind_literals(mod).items()):

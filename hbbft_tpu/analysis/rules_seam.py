@@ -52,8 +52,16 @@ from hbbft_tpu.analysis.dataflow import (
 )
 from hbbft_tpu.analysis.engine import Finding, ModuleSource, Rule, register
 
-SUBMIT_NAME = re.compile(r"(^|_)(submit|dispatch)|_deferred$")
-RESOLVE_NAME = re.compile(r"^(resolve|_resolve|flush|finish|_?fetch)")
+#: the crash axis (net/crash.py) has the same two-sided shape: the LIVE
+#: side (crank hooks logging the WAL/sent record, checkpointing) and the
+#: RECOVERY side (_restart replaying against that record).  Live-side
+#: methods seed "submit", recovery-side methods seed "resolve", so state
+#: crossing checkpoint→replay is inventoried exactly like pipeline state
+#: crossing submit→resolve.
+SUBMIT_NAME = re.compile(
+    r"(^|_)(submit|dispatch)|_deferred$|^(on_(deliver|send|input|enqueue)|_?checkpoint)"
+)
+RESOLVE_NAME = re.compile(r"^(resolve|_resolve|flush|finish|_?fetch|_restart|_replay)")
 #: nested-callable names that identify a delivery/resolver closure
 RESOLVER_NESTED = ("deliver", "resume", "resolve", "finish")
 #: call kwargs that hand a closure to the pipeline as a resolve callback
@@ -171,6 +179,7 @@ class SeamRaceRule(Rule):
         "hbbft_tpu/ops/pipeline.py",
         "hbbft_tpu/ops/backend.py",
         "hbbft_tpu/engine/",
+        "hbbft_tpu/net/crash.py",
     )
 
     def check_module(self, mod: ModuleSource) -> List[Finding]:

@@ -40,6 +40,14 @@ FAULT_KINDS = {
         "non_validator_sender",
         "unknown_kind",
     ),
+    # crash/restart axis (net/crash.py): recovery failures are attributed
+    # evidence against the crashed node — a cell whose restart could not
+    # complete fails its verdict visibly instead of crashing the harness
+    "crash": (
+        "checkpoint_failed",
+        "recovery_failed",
+        "replay_divergence",
+    ),
     "broadcast": (
         "bad_length_prefix",
         "conflicting_echo",

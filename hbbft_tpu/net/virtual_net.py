@@ -26,8 +26,21 @@ from hbbft_tpu.core.network_info import NetworkInfo
 from hbbft_tpu.core.types import CryptoWork, Step, TargetedMessage
 from hbbft_tpu.crypto.backend import CryptoBackend, MockBackend
 from hbbft_tpu.net.adversary import Adversary, NullAdversary
+from hbbft_tpu.net.crash import CrashEvent, CrashManager, CrashSchedule
 from hbbft_tpu.obs.tracer import Tracer
 from hbbft_tpu.utils.metrics import Counters, EventLog
+
+__all__ = [
+    "CrankError",
+    "CrashEvent",
+    "CrashSchedule",
+    "NetBuilder",
+    "NetMessage",
+    "NetSchedule",
+    "Node",
+    "Partition",
+    "VirtualNet",
+]
 
 
 class CrankError(Exception):
@@ -190,6 +203,9 @@ class VirtualNet:
     crank_chooser = None
     race_probe = None
     _SNAPSHOT_ENV_ATTRS = ("traffic", "crank_chooser", "race_probe")
+    #: class fallback so pre-crash-axis whole-net snapshots restore
+    #: (decode sets only serialized attrs); instances always assign it
+    crash = None
 
     def __init__(
         self,
@@ -205,6 +221,7 @@ class VirtualNet:
         tracer: Optional[Tracer] = None,
         schedule: Optional[NetSchedule] = None,
         scenario_name: Optional[str] = None,
+        crash_schedule: Optional[CrashSchedule] = None,
     ) -> None:
         self.nodes = nodes
         self.backend = backend
@@ -220,6 +237,21 @@ class VirtualNet:
         self.schedule = schedule
         #: scenario label (net/scenarios.py) surfaced by why_stalled
         self.scenario_name = scenario_name
+        #: optional crash/restart axis (net/crash.py); None keeps every
+        #: code path byte-identical to the crash-free runtime
+        if crash_schedule is not None and defer_mode != "eager":
+            # the WAL replay model re-derives the crash-time state by
+            # re-handling logged events with eager crypto resolution; the
+            # round barrier resolves work BETWEEN cranks against shared
+            # net state the WAL cannot capture, so a restart under
+            # defer_mode="round" would always read as replay divergence
+            raise ValueError(
+                "crash schedules require defer_mode='eager' (the WAL "
+                "replay cannot reproduce the round-barrier resolution)"
+            )
+        self.crash = (
+            CrashManager(crash_schedule) if crash_schedule is not None else None
+        )
         #: virtual clock in cranks; advances 1 per delivery and
         #: fast-forwards when every pending message is future-dated
         self.now = 0
@@ -270,9 +302,15 @@ class VirtualNet:
     def node(self, node_id) -> Node:
         return self.nodes[node_id]
 
+    def down_node_ids(self) -> frozenset:
+        """Nodes currently dead under the crash axis (empty without one)."""
+        return self.crash.down_ids() if self.crash is not None else frozenset()
+
     # -- input ---------------------------------------------------------------
 
     def send_input(self, node_id, input: Any) -> Step:
+        if self.crash is not None and self.crash.on_input(self, node_id, input):
+            return Step()  # node is down: input parked until restart
         node = self.nodes[node_id]
         step = node.algorithm.handle_input(input, rng=self.rng)
         self._process_step(node, step)
@@ -308,6 +346,8 @@ class VirtualNet:
     def crank(self) -> Optional[Tuple[Any, Step]]:
         """Deliver one message.  Returns (recipient, step) or None if idle."""
         self._release_due()
+        if self.crash is not None:
+            self.crash.on_crank(self)
         self.adversary.pre_crank(self)
         if not self.queue:
             self._flush_work()
@@ -318,6 +358,12 @@ class VirtualNet:
                 # never burns cranks; real time IS the crank count)
                 self.now = self._future[0][0]
                 self._release_due()
+            if not self.queue and self.crash is not None:
+                # pending crash-axis events (a tick-gated crash/restart,
+                # or an epoch-gated restart the drained net starved out)
+                # are fast-forwarded like future-dated messages
+                if self.crash.on_idle(self):
+                    self._release_due()
             if not self.queue:
                 return None
         self.cranks += 1
@@ -344,6 +390,8 @@ class VirtualNet:
             raise self._crank_error(
                 f"message limit {self.message_limit} exceeded"
             )
+        if self.crash is not None:
+            self.crash.on_deliver(self, msg)
         probe = self.race_probe
         if probe is not None:
             probe.begin_crank(msg)
@@ -376,6 +424,8 @@ class VirtualNet:
         self._process_step(node, step)
         if probe is not None:
             probe.end_crank()
+        if self.crash is not None:
+            self.crash.after_crank(self)
         return msg.to, step
 
     def crank_round(self) -> int:
@@ -445,6 +495,8 @@ class VirtualNet:
         recipients = tm.target.recipients(self._sorted_ids, our_id=node.id)
         for to in recipients:
             msg = NetMessage(node.id, to, tm.message)
+            if self.crash is not None and self.crash.on_send(self, node, msg):
+                continue  # replayed emission already delivered pre-crash
             if node.faulty:
                 for m in self.adversary.tamper(self, msg):
                     self._enqueue(m)
@@ -457,6 +509,8 @@ class VirtualNet:
         traffic is scheduled exactly like honest traffic.  Future-dated
         messages park on the time-ordered heap and enter ``queue`` only
         once deliverable."""
+        if self.crash is not None and self.crash.on_enqueue(self, msg):
+            return  # recipient is down: parked until its restart
         if self.race_probe is not None:
             # stable content key + causal edge to the enqueuing crank
             self.race_probe.tag_message(msg)
@@ -532,6 +586,7 @@ class NetBuilder:
         self._defer_mode = "eager"
         self._scheduler = "random"
         self._schedule: Optional[NetSchedule] = None
+        self._crash_schedule: Optional[CrashSchedule] = None
         self._scenario_name: Optional[str] = None
         self._event_log: Optional[EventLog] = None
         self._tracer: Optional[Tracer] = None
@@ -573,6 +628,12 @@ class NetBuilder:
         """Attach a network-condition schedule (latency/jitter/drop/
         partition-and-heal); None keeps instant delivery."""
         self._schedule = sched
+        return self
+
+    def crashes(self, sched: Optional[CrashSchedule]) -> "NetBuilder":
+        """Attach a crash/restart schedule (net/crash.py); None keeps the
+        crash-free runtime byte-identical."""
+        self._crash_schedule = sched
         return self
 
     def scenario(self, name: str) -> "NetBuilder":
@@ -646,4 +707,5 @@ class NetBuilder:
             tracer=self._tracer,
             schedule=self._schedule,
             scenario_name=self._scenario_name,
+            crash_schedule=self._crash_schedule,
         )

@@ -197,6 +197,49 @@ def _scenario_summary(ctx: Dict[str, Any]) -> str:
     return "; ".join(parts)
 
 
+def _crash_context(net: Any) -> Optional[Dict[str, Any]]:
+    """Crash-axis state of a runner with a crash manager attached
+    (``net.crash`` — hbbft_tpu/net/crash.py): which nodes are down since
+    when, which checkpoint each would restore from, and completed
+    restarts.  Duck-typed and total like the other contexts."""
+    cm = getattr(net, "crash", None)
+    if cm is None:
+        return None
+    describe = getattr(cm, "describe", None)
+    if not callable(describe):
+        return None
+    try:
+        ctx = dict(describe(getattr(net, "now", 0)))
+    except Exception:
+        return None
+    return ctx if ctx.get("nodes") else None
+
+
+def _crash_summary(ctx: Dict[str, Any]) -> List[str]:
+    lines: List[str] = []
+    for nid, st in sorted(ctx.get("nodes", {}).items()):
+        state = st.get("state")
+        if state in ("down", "restoring"):
+            ck = st.get("checkpoint_epoch", [0, 0])
+            lines.append(
+                f"node {nid} down since crank {st.get('down_since_crank')}"
+                f" / restoring from checkpoint at epoch "
+                f"(era {ck[0]}, epoch {ck[1]})"
+                + (
+                    ""
+                    if st.get("restart_pending")
+                    else " — no restart scheduled"
+                )
+            )
+        elif state == "failed":
+            lines.append(
+                f"node {nid} down since crank "
+                f"{st.get('down_since_crank')} — recovery FAILED "
+                "(crash:recovery_failed attributed)"
+            )
+    return lines
+
+
 def _traffic_context(net: Any) -> Optional[Dict[str, Any]]:
     """Traffic-source state of a runner driven by the traffic subsystem
     (``net.traffic`` is a driver exposing ``status()`` —
@@ -251,6 +294,10 @@ def why_stalled(net_or_nodes: Any) -> Dict[str, Any]:
     if ctx is not None:
         report["scenario"] = ctx
         report["summary"].append(_scenario_summary(ctx))
+    cctx = _crash_context(net_or_nodes)
+    if cctx is not None:
+        report["crash"] = cctx
+        report["summary"].extend(_crash_summary(cctx))
     tctx = _traffic_context(net_or_nodes)
     if tctx is not None:
         report["traffic"] = tctx

@@ -338,9 +338,23 @@ class ObjectTrafficDriver(_TrafficBase):
         # algorithm — only instrument the ones that expose the hook.
         self._t_sample = 1.0  # wave 0's unit boundary
         for nid in ids:
-            alg = net.nodes[nid].algorithm
-            if hasattr(alg, "sample_listener"):
-                alg.sample_listener = self._on_sampled
+            self._install_sample_hook(net.nodes[nid].algorithm)
+        # crash axis (net/crash.py): a restored node comes back from a
+        # snapshot, which drops the env-attr sample hook — re-install it
+        crash = getattr(net, "crash", None)
+        if crash is not None:
+            crash.add_restart_listener(self._on_restart)
+
+    def _install_sample_hook(self, alg) -> None:
+        # the hook lives on the wrapped QHB, not a SenderQueue wrapper:
+        # setting it on the wrapper would shadow nothing (QHB reads
+        # self.sample_listener) AND make the wrapper unsnapshotable
+        inner = getattr(alg, "algo", alg)
+        if hasattr(inner, "sample_listener"):
+            inner.sample_listener = self._on_sampled
+
+    def _on_restart(self, net, node_id, algo) -> None:
+        self._install_sample_hook(algo)
 
     def _on_sampled(self, sample: List[Any]) -> None:
         self.tracker.on_sampled(sample, self._t_sample)
@@ -356,10 +370,15 @@ class ObjectTrafficDriver(_TrafficBase):
         target = k + 1
 
         def delivered(net) -> bool:
+            down = (
+                net.down_node_ids()
+                if hasattr(net, "down_node_ids")
+                else frozenset()
+            )
             return all(
                 len(net.nodes[nid].outputs) >= target
                 for nid in self.ids
-                if not net.nodes[nid].faulty
+                if not net.nodes[nid].faulty and nid not in down
             )
 
         try:

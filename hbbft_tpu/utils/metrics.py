@@ -33,6 +33,16 @@ class Counters:
     # by loss/partition policy vs merely future-dated by latency/jitter
     schedule_dropped: int = 0
     schedule_delayed: int = 0
+    # crash/restart axis (net/crash.py): node deaths, completed restarts,
+    # traffic parked for down nodes, WAL events re-handled during recovery,
+    # replayed emissions suppressed as already-delivered, and checkpoints
+    # taken (baseline + periodic)
+    node_crashes: int = 0
+    node_restarts: int = 0
+    crash_parked_messages: int = 0
+    crash_replayed_events: int = 0
+    crash_suppressed_sends: int = 0
+    crash_checkpoints: int = 0
     # crypto-side: items verified per kind
     sig_shares_verified: int = 0
     dec_shares_verified: int = 0

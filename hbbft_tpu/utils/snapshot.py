@@ -87,6 +87,10 @@ _STATE_MODULES = (
     # deterministically from bytes.
     "hbbft_tpu.net.virtual_net",
     "hbbft_tpu.net.adversary",
+    # crash axis: schedules, per-node tracks (checkpoint blobs, WALs,
+    # parked traffic) — a whole-net snapshot taken mid-outage resumes
+    # with the outage intact
+    "hbbft_tpu.net.crash",
 )
 
 _registry_cache: Optional[Dict[str, type]] = None

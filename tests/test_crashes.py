@@ -1,0 +1,479 @@
+"""Crash/restart fault injection (net/crash.py) + the composed gauntlet
+(net/scenarios.py Cell runner): a node dies mid-epoch, restores from its
+last utils/snapshot checkpoint, replays its WAL bit-identically (peers
+never see a restart as equivocation), catches up through the sender-queue
+window, and commits the same Batches — composed with adversaries, network
+schedules, era-change churn, and client traffic, all seeded-replayable.
+
+The N=16 x 200-epoch acceptance cell runs slow-marked; tier-1 covers the
+same composition at small N (~0.5 s per cell)."""
+
+import pytest
+
+from hbbft_tpu.crypto.backend import MockBackend
+from hbbft_tpu.net.scenarios import (
+    CHURNS,
+    CRASHES,
+    TRAFFICS,
+    Cell,
+    run_cell,
+)
+from hbbft_tpu.net.virtual_net import (
+    CrankError,
+    CrashEvent,
+    CrashSchedule,
+    NetBuilder,
+)
+from hbbft_tpu.protocols.queueing_honey_badger import QueueingHoneyBadgerBuilder
+from hbbft_tpu.protocols.sender_queue import SenderQueue
+
+
+def _qhb_net(n=4, f=0, crash=None, seed=5, batch_size=3):
+    def make(ni, be, rng):
+        return SenderQueue(
+            QueueingHoneyBadgerBuilder(ni, be, rng)
+            .batch_size(batch_size)
+            .build()
+        )
+
+    return (
+        NetBuilder(range(n))
+        .num_faulty(f)
+        .backend(MockBackend())
+        .crashes(crash)
+        .crank_limit(2_000_000)
+        .using(make)
+        .build(seed=seed)
+    )
+
+
+def _boot(net):
+    for i in sorted(net.nodes):
+        net.send_input(i, ("user", ("boot", i)))
+
+
+def _run_epochs(net, epochs, max_cranks=400_000):
+    def live_done(nt, k):
+        down = nt.down_node_ids()
+        return all(
+            len(nd.outputs) >= k + 1
+            for nd in nt.correct_nodes()
+            if nd.id not in down
+        )
+
+    for k in range(epochs):
+        net.crank_until(lambda nt, k=k: live_done(nt, k), max_cranks=max_cranks)
+
+
+def _faults(net):
+    return [
+        (n.id, f.kind) for n in net.nodes.values() for f in n.faults_observed
+    ]
+
+
+# ---------------------------------------------------------------------------
+# The crash/restart axis itself
+# ---------------------------------------------------------------------------
+
+
+def test_crash_parks_traffic_and_restart_catches_up():
+    """A node dies at epoch 3, its traffic parks instead of delivering,
+    and after restart it recommits the same Batches as everyone else."""
+    cs = CrashSchedule(
+        (CrashEvent(node_id=3, at_epoch=3, down_epochs=4),),
+        checkpoint_every=2,
+    )
+    net = _qhb_net(crash=cs)
+    _boot(net)
+    _run_epochs(net, 14)
+    st = net.crash.stats()
+    assert st["crashes"] == 1 and st["restarts"] == 1
+    assert net.counters.crash_parked_messages > 0
+    assert net.counters.crash_checkpoints >= 1
+    # replay was bit-identical: every re-emitted message matched the
+    # sent log, so nothing was double-delivered and no fault recorded
+    assert net.counters.crash_suppressed_sends > 0
+    assert _faults(net) == []
+    common = min(len(n.outputs) for n in net.nodes.values())
+    assert common >= 14
+    ref = net.nodes[0].outputs[:common]
+    for i in net.nodes:
+        assert net.nodes[i].outputs[:common] == ref, f"node {i} diverged"
+    rec = st["recoveries"][0]
+    assert rec["replayed_events"] > 0
+    assert rec["behind_after_replay"] >= 0
+
+
+def test_restart_restores_from_mid_epoch_checkpoint():
+    """checkpoint_every=1 forces the recovery point between epochs; the
+    WAL replay then crosses epoch state mid-flight.  The restored node
+    must still match the network bit for bit."""
+    cs = CrashSchedule(
+        (CrashEvent(node_id=2, at_epoch=2, down_epochs=3),),
+        checkpoint_every=1,
+    )
+    net = _qhb_net(crash=cs, seed=9)
+    _boot(net)
+    _run_epochs(net, 10)
+    assert net.crash.stats()["restarts"] == 1
+    assert _faults(net) == []
+    common = min(len(n.outputs) for n in net.nodes.values())
+    ref = net.nodes[0].outputs[:common]
+    for i in net.nodes:
+        assert net.nodes[i].outputs[:common] == ref
+
+
+def test_down_node_inputs_park_and_apply_at_restart():
+    """send_input to a dead node returns an empty Step; the parked input
+    lands after restart (the client-retry model) and commits."""
+    cs = CrashSchedule(
+        (CrashEvent(node_id=3, at_epoch=2, down_epochs=3),), checkpoint_every=2
+    )
+    net = _qhb_net(crash=cs, seed=7)
+    _boot(net)
+    _run_epochs(net, 3)
+    assert net.crash.is_down(3), "node 3 should be down by epoch 3"
+    step = net.send_input(3, ("user", ("late", "tx")))
+    assert not step.output and not step.messages
+    assert net.crash.tracks[3].parked_inputs
+    _run_epochs(net, 12)
+    assert not net.crash.is_down(3)
+    committed = {
+        tx
+        for b in net.nodes[0].outputs
+        for txs in b.contributions.values()
+        if isinstance(txs, list)
+        for tx in txs
+    }
+    assert ("late", "tx") in committed
+
+
+def test_corrupted_checkpoint_is_attributed_not_raised():
+    """An unreadable checkpoint must surface as crash:recovery_failed
+    against the crashed node — the run continues, the harness never
+    raises, and the node stays down."""
+    cs = CrashSchedule(
+        (CrashEvent(node_id=3, at_epoch=2, down_epochs=2),), checkpoint_every=2
+    )
+    net = _qhb_net(crash=cs, seed=3)
+    _boot(net)
+    _run_epochs(net, 2)
+    # arm() took the baseline checkpoint; corrupt whatever is current
+    net.crash.tracks[3].ckpt_blob = b"HBTPUSNAP1corrupt"
+    _run_epochs(net, 8)
+    kinds = [k for _, k in _faults(net)]
+    assert "crash:recovery_failed" in kinds
+    assert net.crash.tracks[3].state == "failed"
+    # the other three nodes carried the run (f-budget covers the loss)
+    live = [n for n in net.correct_nodes() if n.id != 3]
+    assert all(len(n.outputs) >= 8 for n in live)
+
+
+def test_why_stalled_names_down_node():
+    """A cell starved by a dead node names it: 'node X down since crank
+    N / restoring from checkpoint at epoch e'."""
+    from hbbft_tpu.net.adversary import SilentAdversary
+
+    cs = CrashSchedule(
+        (CrashEvent(at_epoch=1, down_epochs=None, down_ticks=None,
+                    restart=False),),
+        checkpoint_every=2,
+    )
+
+    # one truly silent faulty node + one dead honest node leaves 2 live
+    # participants — below every N-f=3 quorum, so the net starves and
+    # the diagnosis must name the outage
+    def make(ni, be, rng):
+        return SenderQueue(
+            QueueingHoneyBadgerBuilder(ni, be, rng).batch_size(3).build()
+        )
+
+    net = (
+        NetBuilder(range(4))
+        .num_faulty(1)
+        .adversary(SilentAdversary())
+        .backend(MockBackend())
+        .crashes(cs)
+        .crank_limit(2_000_000)
+        .using(make)
+        .build(seed=2)
+    )
+    _boot(net)
+    with pytest.raises(CrankError) as ei:
+        _run_epochs(net, 30)
+    report = ei.value.report
+    assert report is not None and "crash" in report
+    text = str(ei.value)
+    assert "down since crank" in text
+    assert "restoring from checkpoint at epoch" in text
+
+
+def test_epoch_gated_restart_released_on_starvation():
+    """An epoch-gated restart whose epoch mark can never advance (the
+    net starves without the dead node) fires at quiescence instead of
+    deadlocking — the LaggardAdversary starvation-release convention."""
+    from hbbft_tpu.net.adversary import SilentAdversary
+
+    cs = CrashSchedule(
+        (CrashEvent(at_epoch=1, down_epochs=50),), checkpoint_every=2
+    )
+
+    def make(ni, be, rng):
+        return SenderQueue(
+            QueueingHoneyBadgerBuilder(ni, be, rng).batch_size(3).build()
+        )
+
+    # silent faulty + dead honest = 2 live < every quorum of 3: epochs
+    # freeze, so the down_epochs=50 mark would never be reached
+    net = (
+        NetBuilder(range(4))
+        .num_faulty(1)
+        .adversary(SilentAdversary())
+        .backend(MockBackend())
+        .crashes(cs)
+        .crank_limit(2_000_000)
+        .using(make)
+        .build(seed=6)
+    )
+    _boot(net)
+    _run_epochs(net, 6)
+    st = net.crash.stats()
+    assert st["crashes"] == 1 and st["restarts"] == 1
+    assert _faults(net) == []
+    live = [n for n in net.correct_nodes()]
+    assert all(len(n.outputs) >= 6 for n in live)
+
+
+def test_tick_gated_restart_keeps_its_outage_at_idle():
+    """A tick-gated restart is NOT starvation-released: when the net
+    drains, the clock fast-forwards to each configured restart time in
+    order instead of restarting everything at once."""
+    cs = CrashSchedule(
+        (
+            CrashEvent(node_id=2, at=5, at_epoch=None, down_epochs=None,
+                       down_ticks=100),
+            CrashEvent(node_id=3, at=5, at_epoch=None, down_epochs=None,
+                       down_ticks=5000),
+        ),
+        checkpoint_every=2,
+    )
+    net = _qhb_net(crash=cs, seed=8)
+    _boot(net)
+    _run_epochs(net, 8, max_cranks=800_000)
+    # node 2's short outage is over; node 3's 5000-tick outage HOLDS —
+    # before the fix, any momentary queue drain force-restarted it
+    assert net.crash.stats()["restarts"] == 1
+    assert net.crash.is_down(3)
+    assert net.now < 5005
+    _run_epochs(net, 35, max_cranks=2_000_000)
+    rec3 = [r for r in net.crash.stats()["recoveries"] if r["node"] == "3"]
+    assert rec3, "node 3 never restarted"
+    assert net.now >= 5005, f"node 3 restarted early at now={net.now}"
+    assert _faults(net) == []
+
+
+def test_crash_schedule_rejects_round_defer_mode():
+    """The WAL replay model needs eager crypto resolution; composing a
+    crash schedule with the round barrier is a configuration error, not
+    a latent replay-divergence fault."""
+    cs = CrashSchedule((CrashEvent(at_epoch=1, down_epochs=2),))
+
+    def make(ni, be, rng):
+        return SenderQueue(
+            QueueingHoneyBadgerBuilder(ni, be, rng).batch_size(3).build()
+        )
+
+    with pytest.raises(ValueError, match="eager"):
+        (
+            NetBuilder(range(4))
+            .backend(MockBackend())
+            .defer_mode("round")
+            .crashes(cs)
+            .using(make)
+            .build(seed=1)
+        )
+
+
+def test_restored_manager_accepts_restart_listeners():
+    """After a whole-net restore the env-attr fallback for
+    restart_listeners is the class-level (); add_restart_listener (the
+    driver's path) must still work."""
+    from hbbft_tpu.utils.snapshot import load_node, save_node
+
+    cs = CrashSchedule(
+        (CrashEvent(node_id=3, at_epoch=2, down_epochs=3),), checkpoint_every=2
+    )
+    net = _qhb_net(crash=cs, seed=5)
+    _boot(net)
+    _run_epochs(net, 3)
+    net2 = load_node(save_node(net), MockBackend())
+    calls = []
+    net2.crash.add_restart_listener(lambda nt, nid, algo: calls.append(nid))
+    _run_epochs(net2, 10)
+    assert net2.crash.stats()["restarts"] == 1
+    assert calls == [3]
+
+
+def test_second_crash_replays_through_first_recovery():
+    """Two crashes of the same node: the second WAL replay crosses state
+    written after the first restart (the rebind-to-shared-rng path)."""
+    cell = Cell(
+        attack="passive", schedule="uniform", churn="none",
+        crash="two_restarts", traffic="none", n=4, epochs=14, seed=4,
+    )
+    r = run_cell(cell)
+    assert r.ok, (r.error, r.misattributed, r.missing_expected)
+    assert r.crashes == 2 and r.restarts == 2
+    assert r.recovered_in_time
+
+
+def test_whole_net_snapshot_mid_outage_resumes_identically():
+    """A whole-net checkpoint taken WHILE a node is down carries the
+    outage (parked traffic, WAL, pending restart): the restored net
+    restarts the node at the same point and commits identical Batches."""
+    from hbbft_tpu.utils.snapshot import load_node, save_node
+
+    cs = CrashSchedule(
+        (CrashEvent(node_id=3, at_epoch=2, down_epochs=3),), checkpoint_every=2
+    )
+    net = _qhb_net(crash=cs, seed=5)
+    _boot(net)
+    _run_epochs(net, 3)
+    assert net.crash.is_down(3)
+    net2 = load_node(save_node(net), MockBackend())
+    assert net2.crash is not None and net2.crash.is_down(3)
+    for k in range(3, 10):
+        net.crank_until(
+            lambda nt, k=k: all(
+                len(nd.outputs) >= k + 1
+                for nd in nt.correct_nodes()
+                if nd.id not in nt.down_node_ids()
+            ),
+            max_cranks=400_000,
+        )
+        net2.crank_until(
+            lambda nt, k=k: all(
+                len(nd.outputs) >= k + 1
+                for nd in nt.correct_nodes()
+                if nd.id not in nt.down_node_ids()
+            ),
+            max_cranks=400_000,
+        )
+    assert net.crash.stats()["restarts"] == 1
+    assert net2.crash.stats()["restarts"] == 1
+    for i in net.nodes:
+        assert net.nodes[i].outputs == net2.nodes[i].outputs
+
+
+# ---------------------------------------------------------------------------
+# The composed gauntlet
+# ---------------------------------------------------------------------------
+
+
+def test_registries_cover_all_axes():
+    assert {"none", "era_flip"} <= set(CHURNS)
+    assert {"none", "one_restart", "two_restarts"} <= set(CRASHES)
+    assert {"none", "half_x", "one_x", "two_x"} <= set(TRAFFICS)
+
+
+def test_composed_cell_all_axes_on():
+    """attack x schedule x churn x crash x traffic in ONE cell: the
+    tier-1 miniature of the acceptance soak."""
+    cell = Cell(
+        attack="equivocate", schedule="partition_heal", churn="era_flip",
+        crash="one_restart", traffic="one_x", n=5, epochs=12, seed=3,
+    )
+    r = run_cell(cell)
+    assert r.ok, (r.error, r.misattributed[:3], r.missing_expected)
+    assert r.epochs_committed >= 12
+    assert r.eras == [0, 1, 2], "era_flip churn should turn the era twice"
+    assert r.crashes == 1 and r.restarts == 1 and r.recovered_in_time
+    assert r.fault_kinds.get("broadcast:conflicting_values", 0) > 0
+    assert not r.misattributed
+    assert r.tx_committed > 0 and r.commit_p99 > 0
+
+
+@pytest.mark.parametrize("seed", [3, 11])
+def test_composed_cell_fingerprint_is_stable(seed):
+    """Seeded replay: the same cell reproduces its fingerprint (batch
+    sha256 + fault log + tracker fingerprint + crash trace) bit for bit,
+    and a different seed genuinely perturbs the run."""
+    cell = Cell(
+        attack="crafted_shares", schedule="wan", churn="era_flip",
+        crash="one_restart", traffic="one_x", n=5, epochs=10, seed=seed,
+    )
+    a, b = run_cell(cell), run_cell(cell)
+    assert a.fingerprint() == b.fingerprint()
+    assert a.ok
+    other = run_cell(
+        Cell(**{**cell.to_dict(), "seed": seed + 100})
+    )
+    assert other.fingerprint() != a.fingerprint()
+
+
+def test_lossy_composed_cell_gated_bounded():
+    """The lossy schedule rides the verdict matrix now: a stall under
+    model-violating loss passes iff the committed prefix is identical,
+    nothing was misattributed, the recovery gate held, and the stall
+    names its cause."""
+    cell = Cell(
+        attack="withhold_echo", schedule="lossy", crash="one_restart",
+        n=5, epochs=8, seed=2,
+    )
+    r = run_cell(cell, crank_limit=400_000)
+    assert r.ok and r.bounded
+    assert r.stall_named or r.epochs_committed >= 8
+
+
+def test_soak_replay_record_roundtrip(tmp_path):
+    """tools/soak.py reproduces a cell from its record (cell + seed +
+    fingerprint) alone, and flags a fingerprint mismatch."""
+    import json
+    import sys
+
+    sys.path.insert(0, "tools")
+    import soak
+
+    cell = Cell(
+        attack="replay_flood", schedule="lan", crash="one_restart",
+        traffic="half_x", n=4, epochs=8, seed=6,
+    )
+    r = run_cell(cell)
+    rec = tmp_path / "cell.json"
+    rec.write_text(
+        json.dumps(
+            {"version": 1, "cell": cell.to_dict(), "fingerprint": r.fingerprint()}
+        )
+    )
+    assert soak.replay_record(str(rec), 5_000_000) == 0
+    bad = tmp_path / "bad.json"
+    bad.write_text(
+        json.dumps({"version": 1, "cell": cell.to_dict(), "fingerprint": "0" * 64})
+    )
+    assert soak.replay_record(str(bad), 5_000_000) == 2
+
+
+# ---------------------------------------------------------------------------
+# Slow arms: the acceptance-criteria soak
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", [1, 2])
+def test_flagship_soak_n16_200_epochs(seed):
+    """ISSUE 11 acceptance: equivocator x partition_heal x churn x one
+    crash+restart x 1x traffic at N=16, 200 epochs — honest Batches
+    bit-identical, every fault attributed, the restarted node recommits
+    within the gate, and the seeded-replay fingerprint is stable."""
+    cell = Cell(
+        attack="equivocate", schedule="partition_heal", churn="era_flip",
+        crash="one_restart", traffic="one_x", n=16, epochs=200, seed=seed,
+    )
+    r = run_cell(cell, crank_limit=50_000_000)
+    assert r.ok, (r.error, r.misattributed[:3], r.missing_expected)
+    assert r.epochs_committed >= 200
+    assert r.crashes == 1 and r.restarts == 1 and r.recovered_in_time
+    assert not r.misattributed
+    assert r.tx_committed > 1000
+    r2 = run_cell(cell, crank_limit=50_000_000)
+    assert r2.fingerprint() == r.fingerprint(), "seeded replay diverged"

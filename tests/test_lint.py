@@ -1563,3 +1563,139 @@ def test_no_wildcard_allow_form(tmp_path):
     findings = run_lint(tmp_path, [p])
     assert any(f.rule == "determinism" for f in findings)
     assert all(f.rule != "stale-suppression" for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# Crash/restart axis scopes (PR 11): net/crash.py rides the byzantine
+# hook contract, the fault-kind cross-check, and the seam-race inventory
+# ---------------------------------------------------------------------------
+
+CRASH_PATH = "hbbft_tpu/net/crash.py"
+
+
+def test_byzantine_flags_raise_in_crash_crank_hook():
+    """The crash manager's crank hooks carry the adversary-hook
+    contract: a recovery failure must become an attributed fault, never
+    an exception out of the crank loop."""
+    findings = lint_sources(
+        ByzantineInputRule(),
+        {
+            CRASH_PATH: """\
+            class BadManager:
+                def on_deliver(self, net, msg):
+                    if msg.to not in self.tracks:
+                        raise KeyError(msg.to)
+                def after_crank(self, net):
+                    raise RuntimeError("checkpoint failed")
+            """
+        },
+    )
+    assert (
+        sum("raises inside an adversary hook" in f.message for f in findings)
+        == 2
+    )
+
+
+def test_byzantine_crash_hook_with_fault_path_passes():
+    findings = lint_sources(
+        ByzantineInputRule(),
+        {
+            CRASH_PATH: """\
+            class GoodManager:
+                def on_deliver(self, net, msg):
+                    t = self.tracks.get(msg.to)
+                    if t is not None:
+                        t.wal.append(msg)
+                def _restart(self, net, nid):
+                    try:
+                        self._replay(net, nid)
+                    except Exception:
+                        self._fault(net, nid, "crash:recovery_failed")
+            """
+        },
+    )
+    assert findings == []
+
+
+def test_fault_kinds_crash_namespace_cross_checked():
+    """The emitted-kind scan covers non-protocols owner modules: a crash
+    kind registered but never emitted by net/crash.py is flagged, and an
+    unregistered crash:* emission in net/crash.py is flagged."""
+    fake_log = """\
+FAULT_KINDS = {
+    "broadcast": ("multiple_echos",),
+    "crash": ("recovery_failed", "ghost_kind"),
+}
+"""
+    fake_crash = """\
+class CrashManager:
+    def _restart(self, net, nid):
+        self._fault(net, nid, "crash:recovery_failed")
+"""
+    findings = _fault_kind_lint(
+        {
+            FAULT_LOG_PATH: fake_log,
+            "hbbft_tpu/protocols/broadcast.py": _FAKE_BROADCAST,
+            CRASH_PATH: fake_crash,
+        }
+    )
+    assert any(
+        "'crash:ghost_kind'" in f.message and "no protocol module" in f.message
+        for f in findings
+    ), [f.render() for f in findings]
+    fake_crash_bad = fake_crash + (
+        "    def _crash(self, net, nid):\n"
+        '        self._fault(net, nid, "crash:not_registered")\n'
+    )
+    findings = _fault_kind_lint(
+        {
+            FAULT_LOG_PATH: fake_log,
+            "hbbft_tpu/protocols/broadcast.py": _FAKE_BROADCAST,
+            CRASH_PATH: fake_crash_bad,
+        }
+    )
+    assert any(
+        "'crash:not_registered'" in f.message and "not registered" in f.message
+        for f in findings
+    )
+
+
+def test_seam_race_covers_crash_live_vs_replay_seam():
+    """net/crash.py is in the seam-race scope with live-side hooks
+    (on_deliver/on_send/_checkpoint) seeding "submit" and the recovery
+    side (_restart/_replay) seeding "resolve": state crossing the
+    checkpoint→replay boundary is inventoried like pipeline seam state."""
+    src = """\
+    class Manager:
+        def on_deliver(self, net, msg):
+            self.wal.append(msg)
+
+        def _restart(self, net, nid):
+            for ev in self.wal:
+                net.replay(ev)
+    """
+    findings = lint_sources(
+        SeamRaceRule(), {CRASH_PATH: textwrap.dedent(src)}
+    )
+    assert any("self.wal" in f.message for f in findings), [
+        f.render() for f in findings
+    ]
+    # the blessed form: an allow at the anchor line documents the seam
+    suppressed = lint_sources(
+        SeamRaceRule(),
+        {
+            CRASH_PATH: textwrap.dedent(
+                """\
+                class Manager:
+                    def on_deliver(self, net, msg):
+                        # lint: allow[seam-race] replay runs between cranks
+                        self.wal.append(msg)
+
+                    def _restart(self, net, nid):
+                        for ev in self.wal:
+                            net.replay(ev)
+                """
+            )
+        },
+    )
+    assert not any("self.wal" in f.message for f in suppressed)

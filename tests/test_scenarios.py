@@ -76,6 +76,28 @@ def test_matrix_covers_acceptance_shape():
     assert "broadcast:multiple_echos" in planted
 
 
+@pytest.mark.parametrize("attack", MATRIX_ATTACKS)
+def test_lossy_cells_gated_bounded(attack):
+    """The lossy schedule is back in the verdict matrix (it was flagged
+    out of the LIVENESS matrix in PR 7): under the bounded-degradation
+    contract a lossy cell passes iff the common committed prefix is
+    identical, no fault was misattributed, and a stall names its cause —
+    liveness and expected-fault evidence are waived (a dropped message
+    may starve a quorum or swallow the attack's proof)."""
+    from hbbft_tpu.net.scenarios import MATRIX_SCHEDULES_ALL
+
+    assert "lossy" in MATRIX_SCHEDULES_ALL
+    for seed in (1, 5):
+        r = run_scenario(attack, "lossy", 4, seed=seed, crank_limit=200_000)
+        assert r.ok, (
+            f"{attack}xlossy seed={seed}: error={r.error} "
+            f"misattr={r.misattributed[:3]} prefix={r.prefix_identical}"
+        )
+        if r.error is not None:
+            assert r.bounded  # degraded pass, visibly flagged
+            assert (r.why or {}).get("summary"), "stall must name a cause"
+
+
 def test_first_scheduler_mode():
     """The matrix invariants hold under the deterministic 'first'
     scheduler too (the schedule layer composes with either)."""

@@ -64,6 +64,79 @@ def test_threshold_sign_roundtrip_mid_protocol():
     assert outs_a and outs_a == outs_b
 
 
+def test_mid_epoch_snapshot_between_rbc_output_and_ba_decision():
+    """A checkpoint taken strictly mid-epoch — after at least one RBC
+    instance delivered its value but before its BA instance decided —
+    restores to a node that still decides the identical Batch.  (The
+    quiescent-state coverage elsewhere in this file never exercised the
+    live Subset/BA sub-protocol state; the crash axis, net/crash.py,
+    checkpoints at arbitrary crank boundaries, so this state must
+    round-trip.)"""
+    from hbbft_tpu.protocols.honey_badger import HoneyBadger
+
+    def build(seed):
+        return (
+            NetBuilder(range(4))
+            .backend(MockBackend())
+            .scheduler("first")  # deterministic delivery without rng draws
+            .using(lambda ni, be: HoneyBadger(ni, be, session_id=b"midsnap"))
+            .build(seed=seed)
+        )
+
+    def mid_epoch_node(net):
+        """A node with an RBC value delivered but that BA undecided."""
+        for nid in sorted(net.nodes):
+            es = net.nodes[nid].algorithm._epoch_state
+            for ps in es.subset.proposals.values():
+                if ps.value is not None and ps.decision is None:
+                    return nid
+        return None
+
+    # Run A: uninterrupted reference.
+    ref = build(seed=4)
+    for i in sorted(ref.nodes):
+        ref.send_input(i, {"from": i})
+    ref.crank_until(
+        lambda nt: all(len(nd.outputs) >= 1 for nd in nt.correct_nodes())
+    )
+
+    # Run B: same seed; at the first mid-epoch point, snapshot the node
+    # and REPLACE it with the restored copy, then finish the epoch.
+    net = build(seed=4)
+    for i in sorted(net.nodes):
+        net.send_input(i, {"from": i})
+    target = None
+    for _ in range(200_000):
+        target = mid_epoch_node(net)
+        if target is not None:
+            break
+        assert net.crank() is not None, "quiesced before a mid-epoch point"
+    assert target is not None
+    algo = net.nodes[target].algorithm
+    es = algo._epoch_state
+    assert any(
+        ps.value is not None and ps.decision is None
+        for ps in es.subset.proposals.values()
+    )
+    restored = load_node(save_node(algo), net.backend)
+    # the restored instance must carry the live sub-protocol state
+    res_es = restored._epoch_state
+    assert any(
+        ps.value is not None and ps.decision is None
+        for ps in res_es.subset.proposals.values()
+    )
+    net.nodes[target].algorithm = restored
+    net.crank_until(
+        lambda nt: all(len(nd.outputs) >= 1 for nd in nt.correct_nodes())
+    )
+    # identical Batch on the restored node, its peers, and the reference
+    batch = net.nodes[target].outputs[0]
+    for nid in net.nodes:
+        assert net.nodes[nid].outputs[0] == batch
+    for nid in ref.nodes:
+        assert ref.nodes[nid].outputs[0] == batch
+
+
 def test_whole_network_resume_is_deterministic():
     """Snapshot an entire mid-epoch QHB network; the restored net and the
     original must produce identical outputs from identical futures."""

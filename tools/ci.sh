@@ -12,13 +12,16 @@
 #      no dependency is downloaded).
 #   2. tools/race_explorer.py --smoke — the schedule-space smoke sweep
 #      over the pipeline / traffic-hook / virtualnet seams.
+#   3. tools/soak.py --smoke — one composed-gauntlet cell (equivocator x
+#      partition-heal x churn x crash+restart x 1x traffic), run twice,
+#      fingerprint-stable, ~2 s deterministic.
 #
 # Output is deterministic (lint findings are sorted; the explorer's
-# run/class/prune counts are seeded), so CI diffs are meaningful.  Exit
-# status is nonzero iff any stage found a new finding or a schedule
-# divergence.  Budget: the whole script is a few seconds on one CPU
-# core (no JAX import on any path) — tests/test_race_explorer.py pins
-# it under 60 s in tier-1.
+# run/class/prune counts and the soak cell's fingerprint are seeded), so
+# CI diffs are meaningful.  Exit status is nonzero iff any stage found a
+# new finding, a schedule divergence, or a failed soak verdict.  Budget:
+# the whole script is a few seconds on one CPU core (no JAX import on
+# any path) — tests/test_race_explorer.py pins it under 60 s in tier-1.
 
 set -u -o pipefail
 cd "$(dirname "$0")/.."
@@ -31,6 +34,9 @@ echo "== ci: lint (custom rule families + ruff if installed) =="
 
 echo "== ci: schedule-space race explorer (smoke sweep) =="
 "$PY" tools/race_explorer.py --smoke || rc=1
+
+echo "== ci: composed-gauntlet soak (smoke cell) =="
+"$PY" tools/soak.py --smoke || rc=1
 
 if [ "$rc" -ne 0 ]; then
     echo "ci: FAILED"

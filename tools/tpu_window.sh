@@ -257,6 +257,20 @@ do_qhb_traffic() {
     BENCH_QHB_EPOCHS=2 BENCH_QHB_BATCHES=16,64 BENCH_QHB_RATES=0.5,1.0,2.0 \
     BENCH_QHB_N100=0 timeout 7200 python bench.py
 }
+done_crash_matrix() {
+  has_row "$ART/rows_after_crash_matrix.json" crash_matrix
+}
+do_crash_matrix() {
+  # composed gauntlet ON DEVICE: attack x schedule x churn x
+  # crash+restart x traffic soak cells with real crypto through
+  # TpuBackend (checkpoint/restore + WAL replay run against live device
+  # state — the restored node's re-verifies dispatch to the chip).
+  # Small shapes: the cell verdicts (bit-identical Batches, attributed
+  # faults, recovery gate) are what this step banks, not throughput.
+  HBBFT_TPU_FQ_IMPL=rns BENCH_ONLY=crash_matrix BENCH_CRASH_BACKEND=tpu \
+    BENCH_CRASH_N=5 BENCH_CRASH_EPOCHS=8 \
+    timeout 3600 python bench.py
+}
 done_n32_churn() {
   has_row "$ART/rows_after_n32_churn.json" array_epochs_per_sec_n100 \
     backend=TpuBackend n=32
@@ -296,7 +310,7 @@ do_n100_churn() {
     timeout 18000 python bench.py
 }
 
-STEPS="n100 matrix_rns_a matrix_limb_a matrix_rns_b matrix_limb_b glv_ab host_ab adv_matrix qhb_traffic n16_churn flips10k kernel_levers driver_budget rs_ab n32_churn n64coin n100_churn"
+STEPS="n100 matrix_rns_a matrix_limb_a matrix_rns_b matrix_limb_b glv_ab host_ab adv_matrix qhb_traffic crash_matrix n16_churn flips10k kernel_levers driver_budget rs_ab n32_churn n64coin n100_churn"
 
 for s in $STEPS; do
   if "done_$s"; then

@@ -330,11 +330,24 @@ def _fault_rows(path: str) -> Dict[str, Dict[str, int]]:
     with open(path) as f:
         doc = json.load(f)
     rows = doc["rows"] if isinstance(doc, dict) else doc
-    return {
-        r["metric"]: dict(r["fault_kinds"])
-        for r in rows
-        if isinstance(r.get("fault_kinds"), dict)
-    }
+    out: Dict[str, Dict[str, int]] = {}
+    for r in rows:
+        if not isinstance(r.get("fault_kinds"), dict):
+            continue
+        kinds = dict(r["fault_kinds"])
+        # crash-axis rows (crash_matrix): the injected-crash and
+        # completed-restart counts ride as synthetic kinds, so the axis
+        # silently ceasing to crash/recover (counts -> 0 while the row
+        # persists) is a detection loss exactly like a vanished
+        # crash:recovery_failed / crash:replay_divergence fault count
+        for key, pseudo in (
+            ("crashes", "axis:crashes_injected"),
+            ("restarts", "axis:restarts_completed"),
+        ):
+            if isinstance(r.get(key), int) and r[key]:
+                kinds[pseudo] = r[key]
+        out[r["metric"]] = kinds
+    return out
 
 
 def diff_faults(old_path: str, new_path: str) -> List[Dict[str, Any]]:
