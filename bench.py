@@ -945,6 +945,184 @@ def bench_qhb_traffic() -> dict:
     return row
 
 
+def bench_slo_traffic() -> dict:
+    """The control-plane flagship row: SLO-driven adaptive batch sizing
+    vs every fixed-B cell under the 10×-swing load trace (ROADMAP item
+    3's closed loop; hbbft_tpu/control/).
+
+    One declared SLO (p99 commit latency ≤ BENCH_SLO_P99 epochs), one
+    replayable trace (BENCH_SLO_TRACE, default swing10x: half the period
+    at the base rate, half at 10×), a 10⁶-client Zipf population over
+    sharded mempools — and per cell either a fixed batch size from
+    BENCH_SLO_BATCHES or the AdaptiveBatchController walking the ladder.
+    The acceptance claim recorded on the row: the controller holds the
+    SLO while every fixed-B cell either violates it (small B drowns in
+    the swing's high phase) or sustains lower wall tx/s (large B
+    over-samples the drained pool in the low phase — N decorrelated
+    proposals of a small mempool are ~N× redundant bytes).  A final
+    kill-switch arm re-runs the controller cell under
+    ``HBBFT_TPU_NO_ADAPTIVE_B=1`` and asserts bit-identical batch
+    digests + tracker fingerprint vs the fixed cell at the controller's
+    initial B (``killswitch_identical``).
+
+    ``vs_baseline`` is controller tx/s over the best SLO-compliant
+    fixed cell's tx/s — the number >1.0 IS the claim."""
+    import hashlib as _hashlib
+    import random as _random
+
+    from examples.simulation import make_backend
+    from hbbft_tpu.control import SLO, AdaptiveBatchController, make_trace
+    from hbbft_tpu.engine import ArrayHoneyBadgerNet
+    from hbbft_tpu.obs import Tracer
+    from hbbft_tpu.traffic import (
+        ArrayTrafficDriver,
+        OpenLoopSource,
+        PayloadSizes,
+        ZipfPopulation,
+    )
+
+    n = _env_int("BENCH_SLO_N", 16)
+    epochs = _env_int("BENCH_SLO_EPOCHS", 24)
+    clients = _env_int("BENCH_SLO_CLIENTS", 1_000_000)
+    shards = _env_int("BENCH_SLO_SHARDS", 16)
+    b0 = _env_int("BENCH_SLO_B0", 32)
+    p99_target = float(os.environ.get("BENCH_SLO_P99", "4.0"))
+    trace_name = os.environ.get("BENCH_SLO_TRACE", "swing10x")
+    rate = float(os.environ.get("BENCH_SLO_RATE", str(6.25 * n)))
+    batches = [
+        int(x)
+        for x in os.environ.get("BENCH_SLO_BATCHES", "8,32,128,512").split(",")
+    ]
+    backend_name = os.environ.get("BENCH_SLO_BACKEND", "mock")
+    backend_label = backend_name
+    slo = SLO(p99_epochs=p99_target)
+    # one capacity for EVERY cell (fairness): ~4 epochs of peak load
+    trace = make_trace(trace_name)
+    cap = max(256, int(4 * rate * trace.peak()))
+
+    def cell(batch_size, adaptive):
+        nonlocal backend_label
+        backend = make_backend(backend_name)
+        backend_label = backend.name
+        tracer = Tracer(spans=False)
+        backend.tracer = tracer
+        net = ArrayHoneyBadgerNet(
+            range(n), backend=backend, seed=0, dynamic=True, tracer=tracer
+        )
+        src = OpenLoopSource(
+            rate,
+            ZipfPopulation(clients, 1.1),
+            PayloadSizes("fixed", 32),
+            trace=make_trace(trace_name),
+        )
+        controller = (
+            AdaptiveBatchController(slo, initial_b=batch_size)
+            if adaptive
+            else None
+        )
+        drv = ArrayTrafficDriver(
+            net, src, _random.Random(1234), batch_size=batch_size,
+            mempool_capacity=cap, fanout="all", tracer=tracer,
+            controller=controller, mempool_shards=shards,
+        )
+        digest = _hashlib.sha256()
+
+        def on_batches(batches_map):
+            b = batches_map[net.ids[0]]
+            for p in net.ids:
+                digest.update(bytes(b.contributions[p]))
+
+        net.batch_listeners.append(on_batches)
+        t0 = time.perf_counter()
+        rep = drv.run(epochs)
+        dt = time.perf_counter() - t0
+        lat = rep["tracker"]["commit_latency"]
+        p99 = lat.get("p99", 0.0)
+        out = {
+            "n": n,
+            "batch_size": "adaptive" if adaptive else batch_size,
+            "epochs": epochs,
+            "committed": rep["committed"],
+            "tx_per_epoch": rep["tx_per_epoch"],
+            "tx_per_s": round(rep["committed"] / dt, 2) if dt > 0 else 0.0,
+            "epochs_per_s": round(epochs / dt, 4) if dt > 0 else 0.0,
+            "latency_p50": lat.get("p50", 0.0),
+            "latency_p99": p99,
+            "slo_compliant": bool(slo.compliant(p99 or None)),
+            "mempool_peak_depth": rep["mempool_peak_depth"],
+            "dropped": rep["mempool_dropped"],
+            "state": rep["status"]["state"],
+            "batch_digest": digest.hexdigest(),
+            "tracker_fingerprint": _hashlib.sha256(
+                repr(sorted(drv.tracker.fingerprint().items())).encode()
+            ).hexdigest(),
+        }
+        if adaptive:
+            out["b_trace"] = rep["controller"]["b_trace"]
+            out["steps_up"] = rep["controller"]["steps_up"]
+            out["steps_down"] = rep["controller"]["steps_down"]
+        return out
+
+    fixed_cells = [cell(b, adaptive=False) for b in batches]
+    adaptive_cell = cell(b0, adaptive=True)
+
+    # kill-switch arm: the controller cell pinned to its initial rung
+    # must be bit-identical to the fixed-B0 cell (digest + fingerprint)
+    saved = os.environ.get("HBBFT_TPU_NO_ADAPTIVE_B")
+    os.environ["HBBFT_TPU_NO_ADAPTIVE_B"] = "1"
+    try:
+        killswitch_cell = cell(b0, adaptive=True)
+    finally:
+        if saved is None:
+            os.environ.pop("HBBFT_TPU_NO_ADAPTIVE_B", None)
+        else:
+            os.environ["HBBFT_TPU_NO_ADAPTIVE_B"] = saved
+    fixed_b0 = next(
+        (c for c in fixed_cells if c["batch_size"] == b0), None
+    )
+    if fixed_b0 is None:
+        fixed_b0 = cell(b0, adaptive=False)
+    killswitch_identical = (
+        killswitch_cell["batch_digest"] == fixed_b0["batch_digest"]
+        and killswitch_cell["tracker_fingerprint"]
+        == fixed_b0["tracker_fingerprint"]
+    )
+
+    compliant_fixed = [c for c in fixed_cells if c["slo_compliant"]]
+    best_fixed_compliant = max(
+        (c["tx_per_s"] for c in compliant_fixed), default=0.0
+    )
+    beats = all(
+        (not c["slo_compliant"]) or c["tx_per_s"] < adaptive_cell["tx_per_s"]
+        for c in fixed_cells
+    )
+    return {
+        "metric": "slo_traffic",
+        "value": adaptive_cell["tx_per_s"],
+        "unit": "tx/s",
+        "vs_baseline": (
+            round(adaptive_cell["tx_per_s"] / best_fixed_compliant, 3)
+            if best_fixed_compliant
+            else 0.0
+        ),
+        "baseline": "best SLO-compliant fixed-B cell",
+        "backend": backend_label,
+        "n": n,
+        "epochs": epochs,
+        "clients": clients,
+        "mempool_shards": shards,
+        "mempool_capacity": cap,
+        "rate_per_epoch": rate,
+        "trace": trace.describe(),
+        "slo": slo.describe(),
+        "initial_b": b0,
+        "curve": fixed_cells + [adaptive_cell],
+        "controller_compliant": adaptive_cell["slo_compliant"],
+        "controller_beats_fixed": beats,
+        "killswitch_identical": killswitch_identical,
+    }
+
+
 def bench_g2_sign() -> dict:
     """Batched 254-bit G2 ladders — the sign op of vmapped coin flips."""
     import random
@@ -1922,6 +2100,7 @@ _BENCH_EST_S = {
     "array_n256_soak": 300, "array_n100_dedup": 120, "array_n64_coin": 240,
     "array_n100": 300, "glv_ladder": 180, "adv_matrix": 600,
     "scenario_matrix": 60, "qhb_traffic": 420, "crash_matrix": 120,
+    "slo_traffic": 420,
 }
 
 
@@ -1965,6 +2144,8 @@ def _plan_benches(only, platform: str, budget: float) -> list:
         plan.append(("crash_matrix", bench_crash_matrix))
         # traffic curve: new measured axis, ahead of the support rows
         plan.append(("qhb_traffic", bench_qhb_traffic))
+        # control plane: the adaptive-vs-fixed-B SLO row rides with it
+        plan.append(("slo_traffic", bench_slo_traffic))
         plan += [("rs_encode", bench_rs_encode), ("rs_host", bench_rs_host)]
         if fqk:
             plan.append(("fq_kernel", bench_fq_kernel))
@@ -2005,6 +2186,7 @@ def _plan_benches(only, platform: str, budget: float) -> list:
             ("scenario_matrix", bench_scenario_matrix),
             ("crash_matrix", bench_crash_matrix),
             ("qhb_traffic", bench_qhb_traffic),
+            ("slo_traffic", bench_slo_traffic),
             ("glv_ladder", bench_glv_ladder),
         ]
         if fqk:

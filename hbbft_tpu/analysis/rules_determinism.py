@@ -128,7 +128,11 @@ class DeterminismRule(Rule):
     # draw entropy only from the injected rng and never read wall clocks
     # (same seed ⇒ identical arrival schedule, sampled proposals,
     # Batches, and latency histograms — wall-rate timing belongs to the
-    # CALLER, bench.py).
+    # CALLER, bench.py).  The control plane (hbbft_tpu/control/) rides
+    # the same contract: batch-size decisions are a pure function of
+    # observed virtual-time state + the injected rng, so a seeded
+    # replay reproduces the exact B trace (and the kill-switch A/B
+    # stays bit-identical).
     scope = (
         "hbbft_tpu/protocols/",
         "hbbft_tpu/core/",
@@ -136,6 +140,7 @@ class DeterminismRule(Rule):
         "hbbft_tpu/net/scenarios.py",
         "hbbft_tpu/net/crash.py",
         "hbbft_tpu/traffic/",
+        "hbbft_tpu/control/",
     )
 
     def check_module(self, mod: ModuleSource) -> List[Finding]:

@@ -180,6 +180,14 @@ class SeamRaceRule(Rule):
         "hbbft_tpu/ops/backend.py",
         "hbbft_tpu/engine/",
         "hbbft_tpu/net/crash.py",
+        # the control loop's hook crossing (PR 12): the traffic drivers'
+        # admission/sampling methods call mempool ``submit`` (submit-
+        # seeded), and any future deferred/resolver context added to the
+        # tracker→controller→engine path gets inventoried here — state
+        # shared between those sides must ride the hook APIs
+        # (batch_size_provider / Observation), not ambient self attrs
+        "hbbft_tpu/traffic/driver.py",
+        "hbbft_tpu/control/",
     )
 
     def check_module(self, mod: ModuleSource) -> List[Finding]:

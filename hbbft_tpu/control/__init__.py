@@ -1,0 +1,35 @@
+"""Control plane: SLO-driven adaptive batch sizing.
+
+The traffic subsystem (hbbft_tpu/traffic/) made "millions of users"
+measurable; this package makes the system *react*: declare a service
+objective (:mod:`~hbbft_tpu.control.slo`), drive it with a replayable
+arrival-rate trace (:mod:`~hbbft_tpu.control.trace`), and let the
+:class:`~hbbft_tpu.control.controller.AdaptiveBatchController` walk
+HoneyBadgerBFT's batch-size/latency trade at runtime through the
+engine/QHB ``batch_size_provider`` hook.  The ``slo_traffic`` bench row
+(bench.py) runs the controller against every fixed-B cell under the
+10×-swing trace; ``HBBFT_TPU_NO_ADAPTIVE_B=1`` pins B for bit-identical
+fixed-B replay.
+"""
+
+from hbbft_tpu.control.controller import (
+    LADDER,
+    AdaptiveBatchController,
+    Observation,
+    adaptive_b_enabled,
+)
+from hbbft_tpu.control.slo import MIN_FEASIBLE_P99, SLO
+from hbbft_tpu.control.trace import TRACES, LoadTrace, make_trace, swing10x
+
+__all__ = [
+    "AdaptiveBatchController",
+    "Observation",
+    "LADDER",
+    "adaptive_b_enabled",
+    "SLO",
+    "MIN_FEASIBLE_P99",
+    "LoadTrace",
+    "TRACES",
+    "make_trace",
+    "swing10x",
+]

@@ -135,11 +135,22 @@ class ArrayHoneyBadgerNet:
     # per-node Batch map after every epoch (the traffic subsystem's
     # delivery fan-out); contribution_source, when set, supplies
     # run_epochs' contributions (epoch -> {node: bytes}) instead of the
-    # synthetic random payloads.
+    # synthetic random payloads; batch_size_provider (zero-arg -> int)
+    # publishes the control plane's live batch size B — the adaptive
+    # controller (hbbft_tpu/control/) installs it and the traffic
+    # driver's contribution sampling consults it per epoch.  All are
+    # checkpoint-detached: a restored engine falls back to these
+    # defaults and the embedder re-attaches its environment.
     tracer = None
     batch_listeners: Sequence = ()
     contribution_source = None
-    _SNAPSHOT_ENV_ATTRS = ("tracer", "batch_listeners", "contribution_source")
+    batch_size_provider = None
+    _SNAPSHOT_ENV_ATTRS = (
+        "tracer",
+        "batch_listeners",
+        "contribution_source",
+        "batch_size_provider",
+    )
 
     def __init__(
         self,

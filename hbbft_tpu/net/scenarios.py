@@ -490,11 +490,17 @@ class CrashSpec:
 class TrafficSpec:
     """Traffic axis: open-loop Poisson client load at ``rate_frac`` of
     the nominal per-epoch capacity (validators × batch_size); None runs
-    the soak load-free (QHB commits empty batches)."""
+    the soak load-free (QHB commits empty batches).  ``adaptive`` puts
+    the SLO-driven batch controller (hbbft_tpu/control/) in the loop —
+    B updates ride ("batch_size", B) inputs so they are WAL-logged and
+    crash-restart replay stays bit-identical; ``trace`` names a
+    registered load trace (control/trace.py) modulating the rate."""
 
     name: str
     rate_frac: Optional[float] = None
     description: str = ""
+    adaptive: bool = False
+    trace: Optional[str] = None
 
 
 _CHURN_LIST: Tuple[ChurnSpec, ...] = (
@@ -560,6 +566,21 @@ _TRAFFIC_LIST: Tuple[TrafficSpec, ...] = (
     TrafficSpec("half_x", 0.5, description="0.5x nominal open-loop load"),
     TrafficSpec("one_x", 1.0, description="1x nominal open-loop load"),
     TrafficSpec("two_x", 2.0, description="2x nominal (overload) load"),
+    TrafficSpec(
+        "one_x_adaptive",
+        1.0,
+        description="1x nominal load with the SLO-driven adaptive batch "
+        "controller in the loop (input-borne B updates)",
+        adaptive=True,
+    ),
+    TrafficSpec(
+        "swing_adaptive",
+        0.4,
+        description="0.4x base rate under the 10x-swing trace with the "
+        "adaptive controller in the loop",
+        adaptive=True,
+        trace="swing10x",
+    ),
 )
 
 TRAFFICS: Dict[str, TrafficSpec] = {t.name: t for t in _TRAFFIC_LIST}
@@ -797,10 +818,14 @@ def _soak_collect(result: SoakResult, net, driver) -> None:
         result.commit_p50 = round(lat.percentile(50), 3)
         result.commit_p99 = round(lat.percentile(99), 3)
         # tracker.fingerprint() is a nested dict; hash a sorted repr so
-        # the soak fingerprint stays one hex string
-        result.traffic_fingerprint = hashlib.sha256(
-            repr(sorted(driver.tracker.fingerprint().items())).encode()
-        ).hexdigest()
+        # the soak fingerprint stays one hex string.  The controller's B
+        # trace (when the adaptive axis is on) is part of the replay
+        # contract: a divergent control decision must flip the cell
+        # fingerprint even if throughput happens to match.
+        fp = repr(sorted(driver.tracker.fingerprint().items()))
+        if driver.controller is not None:
+            fp += repr(driver.controller.b_trace())
+        result.traffic_fingerprint = hashlib.sha256(fp.encode()).hexdigest()
         result.traffic_state = rep["status"]["state"]
 
 
@@ -826,13 +851,36 @@ def run_cell(
     driver = None
     if traffic.rate_frac is not None:
         rate = traffic.rate_frac * (cell.n - f) * cell.batch_size
-        source = OpenLoopSource(rate=rate, population=ZipfPopulation(1024))
+        trace = None
+        if traffic.trace is not None:
+            from hbbft_tpu.control.trace import make_trace
+
+            trace = make_trace(traffic.trace)
+        controller = None
+        if traffic.adaptive:
+            from hbbft_tpu.control import SLO, AdaptiveBatchController
+
+            # small-N soak ladder bracketing the cell's batch size; a
+            # generous p99 target — soak cells compose partitions and
+            # outages, and the controller reacting (not the SLO holding)
+            # is what the gauntlet exercises
+            controller = AdaptiveBatchController(
+                SLO(p99_epochs=8.0),
+                initial_b=4,
+                ladder=(2, 4, 8, 16),
+                window=3,
+                hold_epochs=2,
+            )
+        source = OpenLoopSource(
+            rate=rate, population=ZipfPopulation(1024), trace=trace
+        )
         driver = ObjectTrafficDriver(
             net,
             source,
             rng=net.rng,
             batch_size=cell.batch_size,
             mempool_capacity=1 << 12,
+            controller=controller,
         )
 
     churn_epochs = set(churn.make(cell.n, cell.epochs))

@@ -264,17 +264,30 @@ def _traffic_summary(ctx: Dict[str, Any]) -> str:
     src = ctx.get("source") or {}
     name = src.get("source", "traffic") if isinstance(src, dict) else str(src)
     if state == "saturated":
-        return (
+        line = (
             f"traffic source {name} saturated: mempool "
             f"{ctx.get('mempool_depth', '?')}/{ctx.get('capacity', '?')}, "
             f"{ctx.get('dropped', 0)} dropped, {ctx.get('evicted', 0)} evicted"
         )
-    if state == "starved":
-        return (
+    elif state == "starved":
+        line = (
             f"traffic source {name} starved: mempool empty, "
             f"{ctx.get('committed', 0)} committed, nothing pending"
         )
-    return f"traffic source {name} {state}"
+    else:
+        line = f"traffic source {name} {state}"
+    ctrl = ctx.get("controller")
+    if isinstance(ctrl, dict):
+        # the control plane's live operating point rides the stall
+        # report: current B and whether the declared SLO holds
+        slo = ctrl.get("slo") or {}
+        line += (
+            f"; adaptive batch B={ctrl.get('batch_size')} "
+            f"(p99 target {slo.get('p99_epochs')} epochs, "
+            + ("SLO compliant" if ctrl.get("compliant") else "SLO VIOLATED")
+            + ")"
+        )
+    return line
 
 
 def why_stalled(net_or_nodes: Any) -> Dict[str, Any]:

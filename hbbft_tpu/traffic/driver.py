@@ -29,6 +29,7 @@ the CALLER around :meth:`run` — the driver itself never reads a clock.
 
 from __future__ import annotations
 
+import hashlib
 from typing import Any, Dict, List, Optional
 
 from hbbft_tpu.net.virtual_net import CrankError
@@ -51,6 +52,8 @@ class _TrafficBase:
         fanout: str,
         tracer=None,
         health=None,
+        controller=None,
+        mempool_shards: int = 1,
     ) -> None:
         if fanout not in ("all", "one"):
             raise ValueError(f"unknown fanout {fanout!r}")
@@ -61,11 +64,25 @@ class _TrafficBase:
         self.fanout = fanout
         self.tracer = tracer
         self.health = health
+        #: optional hbbft_tpu.control AdaptiveBatchController: observes
+        #: the tracker's recent window once per epoch/wave and steers the
+        #: live batch size through the ``batch_size_provider`` hook
+        #: (array engine) / input-borne updates (object runtime)
+        self.controller = controller
         self.mempools: List[BoundedMempool] = [
-            BoundedMempool(mempool_capacity, policy=mempool_policy)
+            BoundedMempool(
+                mempool_capacity,
+                policy=mempool_policy,
+                shards=mempool_shards,
+            )
             for _ in ids
         ]
         self.tracker = TxTracker(tracer.hist if tracer is not None else None)
+        # sharded pools route by sha256-of-canonical: hash each arrival
+        # ONCE here and share the digest across all N mempools and the
+        # tracker (fanout="all" would otherwise recompute it N+1 times
+        # per tx — pure waste on the million-client hot path)
+        self._shard_routing = mempool_shards > 1
         self._last_wave_shed = False  # most recent wave dropped/evicted
         self.backpressure_epochs = 0
         self.committed_per_epoch: List[int] = []
@@ -86,7 +103,13 @@ class _TrafficBase:
         shed_before = sum(mp.dropped + mp.evicted for mp in self.mempools)
         n = len(self.ids)
         for t, tx in arrivals:
-            self.tracker.on_submit(tx, t)
+            digest = None
+            if self._shard_routing:
+                try:
+                    digest = hashlib.sha256(canonical.encode(tx)).digest()
+                except Exception:
+                    digest = None  # unencodable: mempools route shard 0
+            self.tracker.on_submit(tx, t, digest=digest)
             if self.fanout == "all":
                 targets = range(n)
             else:
@@ -95,7 +118,7 @@ class _TrafficBase:
             best = "dropped"
             victims: List[Any] = []
             for i in targets:
-                outcome = self.mempools[i].submit(tx)
+                outcome = self.mempools[i].submit(tx, digest=digest)
                 if outcome in ("accepted", "evicted_oldest"):
                     best = "accepted"
                     self._accepted_at(i, tx)
@@ -135,6 +158,29 @@ class _TrafficBase:
     def _accepted_at(self, node_idx: int, tx) -> None:
         """Hook: object driver mirrors admission into the live protocol."""
 
+    # -- adaptive batch control ---------------------------------------------
+
+    def _controller_obs(self, epoch: int):
+        """Assemble the controller's Observation from the tracker's
+        recent window + current mempool state (all virtual quantities —
+        the controller never sees a wall clock)."""
+        from hbbft_tpu.control.controller import Observation
+
+        # now=epoch bounds the window to completed epochs: commits are
+        # recorded at commit time (epoch+2) and would otherwise open
+        # future slots that dilute the arrival-rate estimate
+        rs = self.tracker.recent_summary(self.controller.window, now=epoch)
+        return Observation(
+            epoch=epoch,
+            p99=rs["p99"],
+            tx_per_epoch=rs["committed_per_epoch"],
+            arrivals_per_epoch=rs["submitted_per_epoch"],
+            mempool_depth=self.max_depth,
+            backpressure=self.backpressure,
+            validators=len(self.ids),
+            arrivals_last=rs["submitted_last"],
+        )
+
     def _record_depths(self) -> None:
         depth_hist = self.tracer.hist("mempool_depth") if self.tracer else None
         for mp in self.mempools:
@@ -144,6 +190,12 @@ class _TrafficBase:
     def _tick_health(self, epoch: int, msgs: Optional[float] = None) -> None:
         if self.health is None:
             return
+        extra = {}
+        if self.controller is not None:
+            # the controller's current B and SLO compliance ride every
+            # heartbeat (ISSUE 12: the control loop must be observable)
+            extra["batch_size"] = self.controller.current_b
+            extra["slo_compliant"] = self.controller.last_compliant
         self.health.tick(
             epoch=epoch,
             msgs=msgs,
@@ -151,6 +203,7 @@ class _TrafficBase:
             tx_commit_p99=round(self.tracker.commit_p99(), 3),
             tx_committed=self.tracker.committed,
             tx_dropped=self.tracker.dropped,
+            **extra,
         )
 
     # -- introspection (why_stalled / heartbeat surface) ---------------------
@@ -181,7 +234,7 @@ class _TrafficBase:
             state = "starved"
         else:
             state = "flowing"
-        return {
+        out = {
             "source": self.source.describe(),
             "state": state,
             "mempool_depth": depth,
@@ -192,8 +245,20 @@ class _TrafficBase:
             "committed": self.tracker.committed,
             "pending": self.tracker.pending,
         }
+        if self.controller is not None:
+            out["controller"] = self.controller.describe()
+        return out
 
     def report(self) -> Dict[str, Any]:
+        out = self._report_base()
+        if self.controller is not None:
+            out["controller"] = {
+                **self.controller.describe(),
+                "b_trace": self.controller.b_trace(),
+            }
+        return out
+
+    def _report_base(self) -> Dict[str, Any]:
         per_epoch = self.committed_per_epoch
         return {
             "epochs": self.epochs_run,
@@ -236,29 +301,48 @@ class ArrayTrafficDriver(_TrafficBase):
         fanout: str = "all",
         tracer=None,
         health=None,
+        controller=None,
+        mempool_shards: int = 1,
     ) -> None:
         super().__init__(
             list(net.ids), source, rng, batch_size, mempool_capacity,
             mempool_policy, fanout, tracer=tracer, health=health,
+            controller=controller, mempool_shards=mempool_shards,
         )
         self.net = net
         net.batch_listeners = list(net.batch_listeners) + [self._on_batches]
         net.contribution_source = self._contributions_for
+        if controller is not None:
+            # the engine-side hook (checkpoint-detached env attr, like
+            # contribution_source): anything reading the engine sees the
+            # controller's live B
+            net.batch_size_provider = controller.batch_size
 
     # -- engine hooks --------------------------------------------------------
 
     def _contributions_for(self, epoch: int) -> Dict[Any, bytes]:
         """Contribution-sourcing hook: admit the epoch's arrivals, then
-        sample every node's proposal (QHB's ``_try_propose`` math)."""
+        sample every node's proposal (QHB's ``_try_propose`` math).
+
+        The controller (when attached) decides B FIRST, from state
+        observed through the previous epoch's commits only — so the
+        decision sequence is a pure function of the seeded history and
+        replay stays bit-identical."""
+        if self.controller is not None:
+            self.controller.decide(self._controller_obs(epoch))
+        provider = getattr(self.net, "batch_size_provider", None)
+        b = provider() if provider is not None else self.batch_size
         self._admit_wave(epoch)
         t_sample = float(epoch + 1)
         contribs: Dict[Any, bytes] = {}
         for i, nid in enumerate(self.ids):
-            sample = self.mempools[i].choose(self.rng, self.batch_size)
+            sample = self.mempools[i].choose(self.rng, b)
             self.tracker.on_sampled(sample, t_sample)
             if self.tracer is not None:
                 self.tracer.hist("proposal_size").record(len(sample))
             contribs[nid] = canonical.encode(sample)
+        if self.tracer is not None:
+            self.tracer.hist("batch_size").record(b)
         self._record_depths()
         return contribs
 
@@ -311,6 +395,8 @@ class ObjectTrafficDriver(_TrafficBase):
         tracer=None,
         health=None,
         cranks_per_wave: int = 200_000,
+        controller=None,
+        mempool_shards: int = 1,
     ) -> None:
         if mempool_policy == "evict_oldest":
             # admission mirrors accepted txs into each node's REAL QHB
@@ -326,9 +412,14 @@ class ObjectTrafficDriver(_TrafficBase):
         super().__init__(
             ids, source, rng, batch_size, mempool_capacity, mempool_policy,
             fanout, tracer=tracer, health=health,
+            controller=controller, mempool_shards=mempool_shards,
         )
         self.net = net
         self.cranks_per_wave = cranks_per_wave
+        #: last B delivered to the live protocols (input-borne — see
+        #: _apply_batch_size for why object mode does NOT use the
+        #: batch_size_provider hook)
+        self._applied_b: Optional[int] = None
         self._seen_batches = 0  # cursor into node0's committed outputs
         net.traffic = self  # why_stalled traffic context
         # queue-dwell probe: QHB calls back with each fresh proposal
@@ -363,7 +454,26 @@ class ObjectTrafficDriver(_TrafficBase):
         nid = self.ids[node_idx]
         self.net.send_input(nid, ("user", tx))
 
+    def _apply_batch_size(self, b: int) -> None:
+        """Deliver a B change as a ``("batch_size", B)`` INPUT to every
+        node's live QHB rather than through the ``batch_size_provider``
+        hook: inputs are WAL-logged events under the crash axis
+        (net/crash.py), so a restarted node replays the exact B history
+        its pre-crash self observed and the replay stays bit-identical —
+        a provider would answer with TODAY'S B for yesterday's replayed
+        proposals and read as ``crash:replay_divergence``.  A down
+        node's update parks and applies at recovery, like votes."""
+        if b == self._applied_b:
+            return
+        self._applied_b = b
+        for nid in self.ids:
+            self.net.send_input(nid, ("batch_size", b))
+
     def _wave(self, k: int) -> None:
+        if self.controller is not None:
+            self._apply_batch_size(
+                self.controller.decide(self._controller_obs(k))
+            )
         self._t_sample = float(k + 1)
         self._admit_wave(k)
         self._record_depths()

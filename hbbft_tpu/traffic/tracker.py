@@ -43,9 +43,20 @@ def _commit_digest(tx: Any) -> bytes:
 
 
 class TxTracker:
-    """Lifecycle observer; all times are virtual (epoch units)."""
+    """Lifecycle observer; all times are virtual (epoch units).
 
-    def __init__(self, hist_factory=None) -> None:
+    Besides the cumulative histograms, the tracker keeps a bounded
+    RECENT window — per-epoch commit-latency bucket counts plus
+    submitted/committed tallies for the last ``recent_epochs`` epochs —
+    because the adaptive batch controller (hbbft_tpu/control/) steers
+    on the *live* operating point: a run-lifetime p99 would still be
+    quoting the morning's quiet hours in the middle of a spike.  The
+    window is O(recent_epochs × buckets) memory regardless of load and
+    is NOT part of :meth:`fingerprint` (it is derived state; the
+    cumulative counters already pin replay bit-identity).
+    """
+
+    def __init__(self, hist_factory=None, recent_epochs: int = 8) -> None:
         # hist_factory: Tracer.hist-compatible callable so a live tracer
         # owns the histograms (bench rows pick them up via hist_summary);
         # standalone use gets private Histograms.
@@ -59,6 +70,11 @@ class TxTracker:
                 return h
 
         self.hist = hist_factory
+        self.recent_epochs = recent_epochs
+        #: epoch -> {"submitted", "committed", "lat" bucket dict, "lat_min",
+        #: "lat_max"} — trimmed to the last ``recent_epochs`` keys
+        self._recent: Dict[int, Dict[str, Any]] = {}
+        self._first_epoch: Optional[int] = None
         self._pending: Dict[Any, float] = {}  # tx -> submit time
         self._sampled_at: Dict[Any, float] = {}  # tx -> first proposal time
         self._committed: set = set()  # _commit_digest(tx) — never raw txs
@@ -71,11 +87,101 @@ class TxTracker:
         self.invalid = 0  # failed admission validation
         self.shed = 0  # backpressure-deferred by a closed-loop source
 
+    # -- the recent window ---------------------------------------------------
+
+    def _epoch_slot(self, epoch: int) -> Dict[str, Any]:
+        slot = self._recent.get(epoch)
+        if slot is None:
+            slot = self._recent[epoch] = {
+                "submitted": 0,
+                "committed": 0,
+                "lat": {},
+                "lat_min": None,
+                "lat_max": None,
+            }
+            if self._first_epoch is None or epoch < self._first_epoch:
+                self._first_epoch = epoch
+            cutoff = epoch - self.recent_epochs
+            for e in sorted(self._recent):
+                if e <= cutoff:
+                    del self._recent[e]
+        return slot
+
+    def recent_summary(
+        self, window: Optional[int] = None, now: Optional[int] = None
+    ) -> Dict[str, Any]:
+        """Operating point over the last ``window`` epochs (default: the
+        tracker's ``recent_epochs``): merged commit-latency p99 (None
+        when nothing committed in the window), committed and submitted
+        rates per epoch, plus ``submitted_last`` — the newest complete
+        epoch's arrivals, the controller's spike-detection signal (a
+        window AVERAGE dilutes a 10× swing's first epoch 4:1).
+
+        ``now`` bounds the window to slots strictly BEFORE it AND
+        anchors it at ``now - 1``.  Pass the current decision epoch:
+        commits are recorded at their commit time (epoch+2), so without
+        the bound a freshly-committed batch opens future slots whose
+        zero ``submitted`` would dilute the arrival-rate estimate below
+        the true offered load (measured: the controller mis-read a
+        steady 100/epoch as ~50 and stepped B below demand) — and
+        without the anchor a fully-idle tail would freeze the window at
+        the last ACTIVE slot and report the pre-idle rates forever
+        (pinning B high through the idle phase).  Rates divide by the
+        number of epoch SLOTS in the window — silent epochs count as
+        zeros, they are real time."""
+        w = window or self.recent_epochs
+        slots = self._recent
+        if now is not None:
+            slots = {e: s for e, s in self._recent.items() if e < now}
+        if not slots:
+            return {
+                "epochs": 0,
+                "p99": None,
+                "committed_per_epoch": 0.0,
+                "submitted_per_epoch": 0.0,
+                "submitted_last": 0.0,
+            }
+        latest = (now - 1) if now is not None else max(slots)
+        lo = max(latest - w + 1, self._first_epoch or 0)
+        span = latest - lo + 1
+        merged = Histogram("recent_commit_latency")
+        submitted = committed = 0
+        for e in range(lo, latest + 1):
+            slot = slots.get(e)
+            if slot is None:
+                continue
+            submitted += slot["submitted"]
+            committed += slot["committed"]
+            for b, c in sorted(slot["lat"].items()):
+                merged.counts[b] = merged.counts.get(b, 0) + c
+                merged.count += c
+            v = slot["lat_min"]
+            if v is not None and (merged.min is None or v < merged.min):
+                merged.min = v
+            v = slot["lat_max"]
+            if v is not None and (merged.max is None or v > merged.max):
+                merged.max = v
+        last = slots.get(latest)
+        return {
+            "epochs": span,
+            "p99": (
+                round(merged.percentile(99), 3) if merged.count else None
+            ),
+            "committed_per_epoch": round(committed / span, 3),
+            "submitted_per_epoch": round(submitted / span, 3),
+            "submitted_last": float(last["submitted"] if last else 0),
+        }
+
     # -- lifecycle events ----------------------------------------------------
 
-    def on_submit(self, tx: Any, t: float) -> None:
+    def on_submit(self, tx: Any, t: float, digest: bytes = None) -> None:
+        """``digest`` (optional): the tx's full sha256-of-canonical, when
+        the caller already computed it for shard routing — the committed-
+        set key is its 16-byte prefix, so one hash serves both."""
         self.submitted += 1
-        if tx not in self._pending and _commit_digest(tx) not in self._committed:
+        self._epoch_slot(int(t))["submitted"] += 1
+        key = digest[:16] if digest is not None else _commit_digest(tx)
+        if tx not in self._pending and key not in self._committed:
             self._pending[tx] = t
 
     def on_admission(self, outcome: str, tx: Any = None) -> None:
@@ -122,6 +228,7 @@ class TxTracker:
     def on_committed(self, txs: Iterable[Any], t: float) -> int:
         """Record a Batch's transactions; returns newly-committed count."""
         ch = self.hist("tx_commit_latency")
+        slot = self._epoch_slot(int(t))
         new = 0
         for tx in txs:
             d = _commit_digest(tx)
@@ -135,7 +242,15 @@ class TxTracker:
             if sub is None:
                 self.committed_unseen += 1
             else:
-                ch.record(t - sub)
+                lat = t - sub
+                ch.record(lat)
+                b = Histogram._bucket(max(lat, 0.0))
+                slot["lat"][b] = slot["lat"].get(b, 0) + 1
+                if slot["lat_min"] is None or lat < slot["lat_min"]:
+                    slot["lat_min"] = lat
+                if slot["lat_max"] is None or lat > slot["lat_max"]:
+                    slot["lat_max"] = lat
+        slot["committed"] += new
         self.committed += new
         return new
 

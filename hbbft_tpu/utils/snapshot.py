@@ -91,6 +91,12 @@ _STATE_MODULES = (
     # parked traffic) — a whole-net snapshot taken mid-outage resumes
     # with the outage intact
     "hbbft_tpu.net.crash",
+    # control plane: the SLO spec, the adaptive batch controller (its B
+    # trace, hysteresis counters, and rng are replay state), and load
+    # traces — a soak resumed mid-run continues the same control law
+    "hbbft_tpu.control.slo",
+    "hbbft_tpu.control.controller",
+    "hbbft_tpu.control.trace",
 )
 
 _registry_cache: Optional[Dict[str, type]] = None

@@ -65,6 +65,19 @@ def test_qhb_traffic_planned_both_modes(bench):
     assert "qhb_traffic" in bench._BENCH_EST_S
 
 
+def test_slo_traffic_planned_both_modes(bench):
+    """The control-plane row (adaptive vs fixed-B under the swing trace)
+    rides both orderings right after the qhb_traffic curve, ahead of
+    the support rows under a budget, with a cost estimate."""
+    for budget in (0.0, 3000.0):
+        names = [n for n, _ in bench._plan_benches(None, "tpu", budget)]
+        assert "slo_traffic" in names
+        assert names.index("qhb_traffic") < names.index("slo_traffic")
+    budgeted = [n for n, _ in bench._plan_benches(None, "tpu", 3000.0)]
+    assert budgeted.index("slo_traffic") < budgeted.index("rs_encode")
+    assert "slo_traffic" in bench._BENCH_EST_S
+
+
 def test_n100_tpu_gating(bench):
     # off-TPU driver runs never attempt the real-crypto N=100 row...
     assert "array_n100_tpu" not in [

@@ -1,0 +1,533 @@
+"""Control-plane tests: SLO spec, load traces, and the adaptive batch
+controller — unit-level control law, end-to-end array-driver runs,
+seeded-replay bit-identity, the kill switch, snapshot/restore, and the
+composed-gauntlet soak cell with the controller on.
+
+The determinism contract mirrors the traffic subsystem's: decisions are
+a pure function of observed virtual-time state (+ the injected rng for
+the optional probe dither), so same seed ⇒ identical B trace, batch
+digests, and tracker fingerprint.
+"""
+
+import hashlib
+import json
+import random
+
+import pytest
+
+from hbbft_tpu.control import (
+    LADDER,
+    SLO,
+    AdaptiveBatchController,
+    LoadTrace,
+    make_trace,
+    swing10x,
+)
+from hbbft_tpu.control.controller import Observation, _effective_drain
+from hbbft_tpu.control.trace import diurnal, spike, step
+from hbbft_tpu.crypto.backend import MockBackend
+from hbbft_tpu.engine import ArrayHoneyBadgerNet
+from hbbft_tpu.obs.health import HealthReporter, why_stalled
+from hbbft_tpu.traffic import (
+    ArrayTrafficDriver,
+    OpenLoopSource,
+    PayloadSizes,
+    ZipfPopulation,
+)
+
+
+# ---------------------------------------------------------------------------
+# SLO spec
+# ---------------------------------------------------------------------------
+
+
+def test_slo_rejects_infeasible_targets():
+    with pytest.raises(ValueError):
+        SLO(p99_epochs=1.5)  # below the submit->sample->commit floor
+    with pytest.raises(ValueError):
+        SLO(p99_epochs=4.0, margin=0.0)
+    with pytest.raises(ValueError):
+        SLO(p99_epochs=4.0, min_tx_per_epoch=-1)
+
+
+def test_slo_compliance_and_headroom():
+    slo = SLO(p99_epochs=4.0, min_tx_per_epoch=50.0, margin=0.8)
+    assert slo.compliant(3.9, 60.0)
+    assert not slo.compliant(4.1, 60.0)
+    assert not slo.compliant(3.0, 40.0)  # throughput floor missed
+    assert slo.compliant(None)  # idle violates nothing
+    assert slo.headroom(3.2) and not slo.headroom(3.3)
+    d = slo.describe()
+    assert d["p99_epochs"] == 4.0 and d["min_tx_per_epoch"] == 50.0
+
+
+# ---------------------------------------------------------------------------
+# Load traces
+# ---------------------------------------------------------------------------
+
+
+def test_trace_shapes_are_pure_functions_of_epoch():
+    st = step(low=1.0, high=4.0, at=8)
+    assert st.factor(7) == 1.0 and st.factor(8) == 4.0 and st.factor(99) == 4.0
+    sp = spike(low=1.0, high=10.0, at=5, width=2)
+    assert [sp.factor(e) for e in (4, 5, 6, 7)] == [1.0, 10.0, 10.0, 1.0]
+    sw = swing10x(period=12)
+    assert sw.factor(0) == 1.0 and sw.factor(5) == 1.0
+    assert sw.factor(6) == 10.0 and sw.factor(11) == 10.0
+    assert sw.factor(12) == 1.0  # periodic
+    assert sw.peak() == 10.0
+    di = diurnal(low=1.0, high=3.0, period=24)
+    assert di.factor(0) == pytest.approx(1.0)
+    assert di.factor(12) == pytest.approx(3.0)
+    assert 1.0 < di.factor(6) < 3.0
+
+
+def test_trace_registry_and_validation():
+    assert make_trace("swing10x").describe()["trace"] == "swing"
+    with pytest.raises(ValueError):
+        make_trace("nope")
+    with pytest.raises(ValueError):
+        LoadTrace("sawtooth")
+
+
+def test_traced_source_modulates_rate_replayably():
+    tr = step(low=1.0, high=5.0, at=2)
+    src = OpenLoopSource(40.0, ZipfPopulation(100, 1.0), trace=tr)
+    rng = random.Random(4)
+    waves = [len(src.arrivals(rng, e)) for e in range(4)]
+    assert sum(waves[:2]) < sum(waves[2:])  # the step really stepped
+    assert src.describe()["trace"]["trace"] == "step"
+    src2 = OpenLoopSource(40.0, ZipfPopulation(100, 1.0), trace=tr)
+    rng2 = random.Random(4)
+    assert [len(src2.arrivals(rng2, e)) for e in range(4)] == waves
+
+
+# ---------------------------------------------------------------------------
+# Controller: the control law (unit level, synthetic observations)
+# ---------------------------------------------------------------------------
+
+
+def _obs(epoch, *, p99=None, tx=0.0, arr=0.0, last=None, depth=0,
+         bp=False, n=16):
+    return Observation(
+        epoch=epoch, p99=p99, tx_per_epoch=tx, arrivals_per_epoch=arr,
+        mempool_depth=depth, backpressure=bp, validators=n,
+        arrivals_last=arr if last is None else last,
+    )
+
+
+def test_controller_requires_ladder_membership():
+    with pytest.raises(ValueError):
+        AdaptiveBatchController(SLO(4.0), initial_b=33)
+    with pytest.raises(ValueError):
+        AdaptiveBatchController(SLO(4.0), initial_b=8, ladder=(8, 8, 16))
+
+
+def test_steady_load_parks_on_one_rung():
+    c = AdaptiveBatchController(SLO(4.0), initial_b=128)
+    for e in range(30):
+        c.decide(_obs(e, p99=2.5, tx=100.0, arr=100.0, depth=110))
+    trace = c.b_trace()
+    # settles (down from the oversized initial rung) and then HOLDS:
+    # no oscillation under steady load
+    settled = trace[-15:]
+    assert len(set(settled)) == 1
+    assert settled[0] < 128  # it did trade slack for efficiency
+    # the dead band holds: capacity comfortably covers demand
+    assert settled[0] * 16 > 100
+
+
+def test_pressure_ramps_multiple_rungs_in_one_decision():
+    c = AdaptiveBatchController(SLO(4.0), initial_b=16)
+    b = c.decide(_obs(0, tx=100.0, arr=100.0, last=1000.0, depth=900))
+    # one decision must clear the 10x spike, not pay log2(10) epochs
+    assert b * 16 * 0.9 >= 1000.0
+    assert c.decisions[-1][2] == "up:pressure"
+
+
+def test_stale_p99_does_not_escalate_a_drained_pool():
+    c = AdaptiveBatchController(SLO(4.0), initial_b=64, window=2)
+    for e in range(6):
+        # p99 far over target, but the pool is drained: the breach is a
+        # ramp tail, not a live backlog — B must not escalate
+        c.decide(_obs(e, p99=9.0, tx=500.0, arr=100.0, depth=10))
+    assert max(c.b_trace()) == 64
+
+
+def test_down_requires_consecutive_eligibility():
+    c = AdaptiveBatchController(SLO(4.0), initial_b=64, hold_epochs=3)
+    eligible = _obs(0, p99=2.5, tx=50.0, arr=50.0, depth=30)
+    # demand above the next-rung-down threshold (0.7·16·32 = 358) but
+    # inside the current rung's capacity: not down-eligible, not an up
+    busy = _obs(0, p99=2.5, tx=500.0, arr=500.0, depth=500)
+    c.decide(eligible)
+    c.decide(eligible)
+    c.decide(busy)  # resets the hold counter
+    c.decide(eligible)
+    c.decide(eligible)
+    assert c.current_b == 64  # two consecutive, not three
+    c.decide(eligible)
+    assert c.current_b == 32
+
+
+def test_throughput_floor_triggers_up():
+    slo = SLO(4.0, min_tx_per_epoch=200.0)
+    c = AdaptiveBatchController(slo, initial_b=16)
+    b = c.decide(_obs(0, p99=2.0, tx=100.0, arr=100.0, depth=150))
+    assert b > 16
+    assert c.decisions[-1][2] in ("up:floor", "up:pressure")
+
+
+def test_kill_switch_pins_initial_rung(monkeypatch):
+    monkeypatch.setenv("HBBFT_TPU_NO_ADAPTIVE_B", "1")
+    c = AdaptiveBatchController(SLO(4.0), initial_b=32)
+    for e in range(5):
+        b = c.decide(_obs(e, tx=100.0, arr=100.0, last=2000.0, depth=5000))
+    assert b == 32 and c.current_b == 32
+    assert all(r == "killswitch" for _, _, r in c.decisions)
+
+
+def test_probe_jitter_draws_only_from_injected_rng():
+    def run(seed):
+        c = AdaptiveBatchController(
+            SLO(4.0), initial_b=64, rng=random.Random(seed),
+            hold_epochs=2, probe_jitter=3,
+        )
+        for e in range(20):
+            c.decide(_obs(e, p99=2.5, tx=50.0, arr=50.0, depth=30))
+        return c.b_trace()
+
+    assert run(7) == run(7)  # bit-identical replay
+    # without a jitter the rng is never consumed
+    r = random.Random(9)
+    before = r.getstate()
+    c = AdaptiveBatchController(SLO(4.0), initial_b=64, rng=r)
+    for e in range(10):
+        c.decide(_obs(e, p99=2.5, tx=50.0, arr=50.0, depth=30))
+    assert r.getstate() == before
+
+
+def test_effective_drain_model():
+    assert _effective_drain(0, 64, 16) == 0.0
+    # B >= D: everyone proposes everything — the whole pool drains
+    assert _effective_drain(50, 64, 16) == pytest.approx(50.0)
+    # decorrelated overlap: eff is below raw N*B but grows with B
+    lo = _effective_drain(2000, 32, 16)
+    hi = _effective_drain(2000, 128, 16)
+    assert lo < 32 * 16 and lo < hi < 2000
+
+
+def test_controller_snapshot_roundtrip_continues_identically():
+    from hbbft_tpu.utils.snapshot import load_node, save_node
+
+    c = AdaptiveBatchController(
+        SLO(4.0, min_tx_per_epoch=10.0), initial_b=32,
+        rng=random.Random(5), probe_jitter=2,
+    )
+    for e in range(6):
+        c.decide(_obs(e, p99=2.5, tx=50.0, arr=40.0, depth=20))
+    c2 = load_node(save_node(c), MockBackend())
+    assert c2.current_b == c.current_b
+    assert c2.decisions == c.decisions
+    for e in range(6, 14):
+        o = _obs(e, p99=2.2, tx=50.0, arr=40.0, depth=15)
+        assert c.decide(o) == c2.decide(o)
+    assert c.b_trace() == c2.b_trace()
+
+
+# ---------------------------------------------------------------------------
+# End to end: array driver under load traces
+# ---------------------------------------------------------------------------
+
+
+def _swing_run(seed=7, adaptive=True, fixed_b=32, epochs=16, n=8,
+               rate=50.0, period=8, slo_p99=4.0):
+    net = ArrayHoneyBadgerNet(range(n), backend=MockBackend(), seed=1)
+    src = OpenLoopSource(
+        rate, ZipfPopulation(5_000, 1.1), PayloadSizes("fixed", 24),
+        trace=swing10x(period=period),
+    )
+    ctrl = (
+        AdaptiveBatchController(SLO(slo_p99), initial_b=fixed_b)
+        if adaptive
+        else None
+    )
+    drv = ArrayTrafficDriver(
+        net, src, random.Random(seed), batch_size=fixed_b,
+        mempool_capacity=4 * int(rate) * 10, controller=ctrl,
+        mempool_shards=4,
+    )
+    digests = []
+
+    def dl(batches):
+        b = batches[net.ids[0]]
+        h = hashlib.sha256()
+        for p in net.ids:
+            h.update(bytes(b.contributions[p]))
+        digests.append(h.hexdigest())
+
+    net.batch_listeners.append(dl)
+    rep = drv.run(epochs)
+    return drv, rep, digests
+
+
+def test_seeded_replay_bit_identity_of_b_trace_digests_fingerprint():
+    a_drv, a_rep, a_dig = _swing_run(seed=21)
+    b_drv, b_rep, b_dig = _swing_run(seed=21)
+    assert a_rep["controller"]["b_trace"] == b_rep["controller"]["b_trace"]
+    assert a_dig == b_dig
+    assert a_drv.tracker.fingerprint() == b_drv.tracker.fingerprint()
+    c_drv, c_rep, c_dig = _swing_run(seed=22)
+    assert c_dig != a_dig  # the seed really is the input
+
+
+def test_controller_converges_to_slo_on_swing_trace():
+    drv, rep, _ = _swing_run()
+    trace = rep["controller"]["b_trace"]
+    # walked up for the high phase and back down after it
+    assert max(trace) > trace[0] and min(trace[4:]) < max(trace)
+    # holds the declared SLO over the whole run (fixed B=32 at this
+    # shape blows p99 past 10 epochs — asserted below)
+    assert rep["tracker"]["commit_latency"]["p99"] <= 4.0
+    assert rep["controller"]["compliant"]
+
+
+def test_small_fixed_b_violates_where_controller_holds():
+    _, rep, _ = _swing_run(adaptive=False, fixed_b=8)
+    assert rep["tracker"]["commit_latency"]["p99"] > 4.0
+
+
+def test_controller_converges_on_step_and_spike_traces():
+    def run(tr, epochs):
+        net = ArrayHoneyBadgerNet(range(8), backend=MockBackend(), seed=1)
+        src = OpenLoopSource(
+            50.0, ZipfPopulation(2_000, 1.1), PayloadSizes("fixed", 24),
+            trace=tr,
+        )
+        ctrl = AdaptiveBatchController(SLO(4.0), initial_b=16)
+        drv = ArrayTrafficDriver(
+            net, src, random.Random(3), batch_size=16,
+            mempool_capacity=4_000, controller=ctrl,
+        )
+        rep = drv.run(epochs)
+        return drv, rep["controller"]["b_trace"], rep
+
+    # STEP: sustained 6x — B walks up and the END state (once the
+    # observation window has turned over past the one-time ramp) sits
+    # inside the SLO.  The ramp epoch's own tail is bounded but not
+    # under the target; that is the reaction cost of any feedback loop.
+    drv, trace, rep = run(step(low=1.0, high=6.0, at=5), 22)
+    assert max(trace) > 16
+    assert drv.tracker.recent_summary(4, now=22)["p99"] <= 4.0
+    assert rep["tracker"]["commit_latency"]["p99"] < 8.0
+
+    # SPIKE: a 3-epoch flash crowd — B rises for it and DECAYS back once
+    # the backlog drains (a spike must not pin the run on a big rung)
+    drv, trace, rep = run(spike(low=1.0, high=8.0, at=6, width=3), 18)
+    assert max(trace) > 16
+    assert trace[-1] < max(trace)
+    assert drv.tracker.recent_summary(4, now=18)["p99"] <= 4.0
+
+
+def test_hysteresis_no_oscillation_under_steady_load():
+    net = ArrayHoneyBadgerNet(range(8), backend=MockBackend(), seed=1)
+    src = OpenLoopSource(50.0, ZipfPopulation(2_000, 1.1), PayloadSizes("fixed", 24))
+    ctrl = AdaptiveBatchController(SLO(4.0), initial_b=64)
+    drv = ArrayTrafficDriver(
+        net, src, random.Random(9), batch_size=64,
+        mempool_capacity=4_000, controller=ctrl,
+    )
+    rep = drv.run(16)
+    tail = rep["controller"]["b_trace"][-8:]
+    assert len(set(tail)) == 1  # parked on one rung, not flapping
+
+
+def test_kill_switch_reproduces_fixed_b_run_bit_identically(monkeypatch):
+    monkeypatch.setenv("HBBFT_TPU_NO_ADAPTIVE_B", "1")
+    k_drv, k_rep, k_dig = _swing_run(seed=11, adaptive=True, fixed_b=32)
+    monkeypatch.delenv("HBBFT_TPU_NO_ADAPTIVE_B")
+    f_drv, f_rep, f_dig = _swing_run(seed=11, adaptive=False, fixed_b=32)
+    assert k_dig == f_dig
+    assert k_drv.tracker.fingerprint() == f_drv.tracker.fingerprint()
+    assert set(k_rep["controller"]["b_trace"]) == {32}
+    # ...and with the switch off the same seed takes a different path
+    a_drv, _, a_dig = _swing_run(seed=11, adaptive=True, fixed_b=32)
+    assert a_dig != f_dig
+
+
+def test_why_stalled_and_heartbeat_report_b_and_compliance():
+    beats = []
+    health = HealthReporter(interval_s=0.0, sink=beats.append)
+    net = ArrayHoneyBadgerNet(range(4), backend=MockBackend(), seed=3)
+    src = OpenLoopSource(40.0, ZipfPopulation(200, 1.0))
+    ctrl = AdaptiveBatchController(SLO(4.0), initial_b=16)
+    drv = ArrayTrafficDriver(
+        net, src, random.Random(1), batch_size=16,
+        mempool_capacity=128, controller=ctrl, health=health,
+    )
+    drv.run(3)
+    assert beats and "batch_size" in beats[-1]
+    assert beats[-1]["batch_size"] == ctrl.current_b
+    assert beats[-1]["slo_compliant"] is True
+
+    class _Stub:
+        nodes = {}
+        traffic = drv
+
+    report = why_stalled(_Stub())
+    assert report["traffic"]["controller"]["batch_size"] == ctrl.current_b
+    assert any("adaptive batch B=" in s for s in report["summary"])
+
+
+def test_engine_hook_is_checkpoint_detached():
+    net = ArrayHoneyBadgerNet(range(4), backend=MockBackend(), seed=2)
+    ctrl = AdaptiveBatchController(SLO(4.0), initial_b=16)
+    src = OpenLoopSource(10.0, ZipfPopulation(50, 1.0))
+    ArrayTrafficDriver(
+        net, src, random.Random(0), batch_size=16, controller=ctrl
+    )
+    assert net.batch_size_provider is not None
+    restored = ArrayHoneyBadgerNet.restore(net.checkpoint(), MockBackend())
+    assert restored.batch_size_provider is None
+
+
+# ---------------------------------------------------------------------------
+# QHB hooks (object runtime)
+# ---------------------------------------------------------------------------
+
+
+def _qhb(batch_size=3):
+    from hbbft_tpu.core.network_info import NetworkInfo
+    from hbbft_tpu.protocols.queueing_honey_badger import QueueingHoneyBadger
+
+    be = MockBackend()
+    rng = random.Random(0)
+    ni = NetworkInfo.generate_map([0, 1, 2, 3], rng, be)[0]
+    return QueueingHoneyBadger(ni, be, rng=rng, batch_size=batch_size)
+
+
+def test_qhb_batch_size_input_is_state_and_does_not_propose():
+    q = _qhb()
+    step = q.handle_input(("batch_size", 9))
+    assert q.batch_size == 9
+    assert not step.messages and not step.output  # no proposal triggered
+    from hbbft_tpu.utils.snapshot import load_node, save_node
+
+    q2 = load_node(save_node(q), MockBackend())
+    assert q2.batch_size == 9  # input-borne B is snapshotted state
+
+
+def test_qhb_provider_hook_overrides_and_detaches():
+    q = _qhb(batch_size=2)
+    for i in range(10):
+        q.queue.push(("tx", i))
+    q.batch_size_provider = lambda: 7
+    samples = []
+    q.sample_listener = samples.append
+    q._try_propose()
+    assert len(samples[-1]) == 7  # provider, not the stored batch_size
+    from hbbft_tpu.utils.snapshot import load_node, save_node
+
+    q2 = load_node(save_node(q), MockBackend())
+    assert q2.batch_size_provider is None and q2.batch_size == 2
+
+
+def test_object_driver_applies_b_as_inputs():
+    from hbbft_tpu.net.virtual_net import NetBuilder
+    from hbbft_tpu.protocols.queueing_honey_badger import QueueingHoneyBadger
+    from hbbft_tpu.traffic import ObjectTrafficDriver
+
+    net = (
+        NetBuilder(range(4))
+        .num_faulty(1)
+        .crank_limit(10_000_000)
+        .using(
+            lambda ni, be, rng: QueueingHoneyBadger(
+                ni, be, rng=rng, batch_size=4, session_id=b"ctl"
+            )
+        )
+        .build(seed=0)
+    )
+    ctrl = AdaptiveBatchController(SLO(6.0), initial_b=4, ladder=(2, 4, 8, 16))
+    src = OpenLoopSource(12.0, ZipfPopulation(100, 1.0))
+    drv = ObjectTrafficDriver(
+        net, src, random.Random(6), batch_size=4, mempool_capacity=256,
+        controller=ctrl,
+    )
+    rep = drv.run(4)
+    assert rep["committed"] > 0
+    assert rep["controller"]["b_trace"]  # decisions were made
+    # the live QHBs carry the input-borne B as plain state
+    applied = {net.nodes[nid].algorithm.batch_size for nid in drv.ids}
+    assert applied == {ctrl.current_b}
+
+
+# ---------------------------------------------------------------------------
+# Composed gauntlet: controller × crash/restart (snapshot + WAL replay)
+# ---------------------------------------------------------------------------
+
+
+def test_soak_cell_with_controller_survives_crash_restart():
+    from hbbft_tpu.net.scenarios import Cell, run_cell
+
+    cell = Cell(
+        attack="passive", schedule="uniform", churn="none",
+        crash="one_restart", traffic="swing_adaptive",
+        n=4, epochs=10, seed=2,
+    )
+    r1 = run_cell(cell)
+    assert r1.ok, (r1.error, r1.missing_expected, r1.misattributed)
+    assert r1.crashes == 1 and r1.restarts == 1
+    assert r1.tx_committed > 0
+    # the B trace is part of the replay contract: bit-stable fingerprint
+    r2 = run_cell(cell)
+    assert r1.fingerprint() == r2.fingerprint()
+
+
+def test_adaptive_traffic_specs_registered():
+    from hbbft_tpu.net.scenarios import TRAFFICS
+
+    assert TRAFFICS["one_x_adaptive"].adaptive
+    assert TRAFFICS["swing_adaptive"].trace == "swing10x"
+
+
+# ---------------------------------------------------------------------------
+# trace_report: SLO-compliance regression gate
+# ---------------------------------------------------------------------------
+
+
+def _slo_rows_doc(tx_per_s, p99, compliant):
+    return {
+        "meta": {},
+        "rows": [
+            {
+                "metric": "slo_traffic",
+                "value": tx_per_s,
+                "curve": [
+                    {
+                        "n": 16, "batch_size": "adaptive",
+                        "tx_per_s": tx_per_s, "latency_p99": p99,
+                        "slo_compliant": compliant,
+                    }
+                ],
+            }
+        ],
+    }
+
+
+def test_trace_report_gates_slo_compliance(tmp_path):
+    from tools.trace_report import diff_traffic, report_traffic
+
+    old = tmp_path / "old.json"
+    old.write_text(json.dumps(_slo_rows_doc(1000.0, 3.8, True)))
+    ok = tmp_path / "ok.json"
+    ok.write_text(json.dumps(_slo_rows_doc(1005.0, 3.9, True)))
+    assert report_traffic(str(old), str(ok), 0.10) == 0
+
+    lost = tmp_path / "lost.json"
+    # tx/s and p99 both inside tolerance — ONLY compliance flipped
+    lost.write_text(json.dumps(_slo_rows_doc(1001.0, 4.1, False)))
+    assert report_traffic(str(old), str(lost), 0.10) == 1
+    entries = diff_traffic(str(old), str(lost), 0.10)
+    assert entries[0]["slo_regression"]
+    assert not entries[0]["tx_regression"]

@@ -1699,3 +1699,55 @@ def test_seam_race_covers_crash_live_vs_replay_seam():
         },
     )
     assert not any("self.wal" in f.message for f in suppressed)
+
+
+# ---------------------------------------------------------------------------
+# Control-plane scopes (PR 12): hbbft_tpu/control/ rides the determinism
+# contract (entropy only from the injected rng, no wall clocks) and the
+# seam-race inventory covers the tracker -> controller -> engine-hook
+# crossing (traffic/driver.py is submit-seeded via mempool.submit)
+# ---------------------------------------------------------------------------
+
+
+def test_determinism_covers_control_package():
+    src = """\
+    import time
+
+    class Controller:
+        def decide(self, obs):
+            return time.monotonic()
+    """
+    findings = lint_sources(
+        DeterminismRule(), {"hbbft_tpu/control/_seeded.py": src}
+    )
+    msgs = [f.message for f in findings]
+    assert any("nondeterministic module 'time'" in m for m in msgs)
+    assert any("time.monotonic()" in m for m in msgs)
+    assert any("hbbft_tpu/control/" in s for s in DeterminismRule.scope)
+
+
+def test_seam_race_covers_control_and_traffic_driver():
+    assert any("hbbft_tpu/control/" in s for s in SeamRaceRule.scope)
+    assert "hbbft_tpu/traffic/driver.py" in SeamRaceRule.scope
+    # a submit/resolve crossing under the control scope is flagged like
+    # any pipeline seam (nothing in the real package has one — CI pins
+    # the zero-finding state)
+    findings = lint_sources(
+        SeamRaceRule(),
+        {
+            "hbbft_tpu/control/_seeded.py": """\
+            class Controller:
+                def __init__(self):
+                    self.pending = []
+
+                def _submit_decision(self, hook, b):
+                    self.pending.append(b)
+                    hook.submit(b)
+
+                def _resolve(self, res):
+                    return list(self.pending)
+            """
+        },
+    )
+    assert len(findings) == 1
+    assert "self.pending" in findings[0].message

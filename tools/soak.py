@@ -71,6 +71,12 @@ DEFAULT_SUITE = (
     "replay_flood:lan:none:one_restart:half_x",
     "withhold_shares:uniform:era_flip:one_restart:one_x",
     "withhold_echo:lossy:none:one_restart:none",
+    # control plane in the loop (PR 12): the SLO-driven adaptive batch
+    # controller under the 10x-swing trace composed with churn + a
+    # crash/restart — B updates are input-borne, so the restarted
+    # node's WAL replay reproduces the exact B history (the b_trace is
+    # folded into the cell fingerprint)
+    "equivocate:uniform:era_flip:one_restart:swing_adaptive",
 )
 
 #: the acceptance-criteria cell (ISSUE 11): equivocator x partition-heal

@@ -257,6 +257,22 @@ do_qhb_traffic() {
     BENCH_QHB_EPOCHS=2 BENCH_QHB_BATCHES=16,64 BENCH_QHB_RATES=0.5,1.0,2.0 \
     BENCH_QHB_N100=0 timeout 7200 python bench.py
 }
+done_slo_traffic() {
+  has_row "$ART/rows_after_slo_traffic.json" slo_traffic
+}
+do_slo_traffic() {
+  # SLO-driven adaptive batch control ON DEVICE at the north-star shape:
+  # N=100 f=33 real crypto under the 10x-swing trace, controller vs a
+  # short fixed-B grid + the kill-switch identity arm (all in-process).
+  # Short run (12 epochs/cell): the verdict fields (controller_compliant
+  # / controller_beats_fixed / killswitch_identical) and the real-crypto
+  # tx/s anchor are what this step banks; the full curve shape is
+  # already charted by the CPU capture (artifacts/, PERF.md round 12).
+  HBBFT_TPU_FQ_IMPL=rns BENCH_ONLY=slo_traffic BENCH_SLO_BACKEND=tpu \
+    BENCH_SLO_N=100 BENCH_SLO_EPOCHS=12 BENCH_SLO_BATCHES=32,128 \
+    BENCH_SLO_B0=32 BENCH_SLO_CLIENTS=1000000 \
+    timeout 10800 python bench.py
+}
 done_crash_matrix() {
   has_row "$ART/rows_after_crash_matrix.json" crash_matrix
 }
@@ -310,7 +326,7 @@ do_n100_churn() {
     timeout 18000 python bench.py
 }
 
-STEPS="n100 matrix_rns_a matrix_limb_a matrix_rns_b matrix_limb_b glv_ab host_ab adv_matrix qhb_traffic crash_matrix n16_churn flips10k kernel_levers driver_budget rs_ab n32_churn n64coin n100_churn"
+STEPS="n100 matrix_rns_a matrix_limb_a matrix_rns_b matrix_limb_b glv_ab host_ab adv_matrix qhb_traffic slo_traffic crash_matrix n16_churn flips10k kernel_levers driver_budget rs_ab n32_churn n64coin n100_churn"
 
 for s in $STEPS; do
   if "done_$s"; then

@@ -20,11 +20,14 @@ Two subcommands on one small CLI:
   kind that VANISHED while its row persists (an attack that stopped
   being detected) exits 1.
 * ``python tools/trace_report.py --traffic OLD NEW`` — diff the
-  ``qhb_traffic`` throughput/latency curves cell by cell: a sustained
-  tx/s drop beyond ``--tol`` (default 10%) OR a p99 commit-latency
-  increase beyond it is a regression (exit 1) — latency is
-  lower-is-better, unlike every other bench metric, so the generic
-  ``--diff`` mode cannot gate it.
+  ``qhb_traffic``/``slo_traffic`` throughput/latency curves cell by
+  cell: a sustained tx/s drop beyond ``--tol`` (default 10%) OR a p99
+  commit-latency increase beyond it is a regression (exit 1) — latency
+  is lower-is-better, unlike every other bench metric, so the generic
+  ``--diff`` mode cannot gate it.  ``slo_traffic`` cells additionally
+  gate SLO COMPLIANCE: a cell (the controller's ``adaptive`` cell
+  above all) that held the declared SLO in OLD and lost it in NEW
+  exits 1 whatever the ratios.
 
 The validation helpers are imported by the test suite
 (tests/test_obs_tracer.py, tests/test_trace_smoke.py) — keep them
@@ -450,7 +453,18 @@ def diff_traffic(
         entry["old_p99"], entry["new_p99"] = o_p99, n_p99
         entry["tx_regression"] = bool(o_tx and n_tx < o_tx * (1.0 - tol))
         entry["p99_regression"] = bool(o_p99 and n_p99 > o_p99 * (1.0 + tol))
-        entry["regression"] = entry["tx_regression"] or entry["p99_regression"]
+        # SLO-compliance gate (slo_traffic cells carry slo_compliant):
+        # a cell — above all the controller's "adaptive" cell — that
+        # held the declared SLO in the old capture and lost it in the
+        # new one is a regression regardless of throughput ratios
+        entry["slo_regression"] = bool(
+            o.get("slo_compliant") and n.get("slo_compliant") is False
+        )
+        entry["regression"] = (
+            entry["tx_regression"]
+            or entry["p99_regression"]
+            or entry["slo_regression"]
+        )
         out.append(entry)
     return out
 
@@ -471,6 +485,7 @@ def report_traffic(old_path: str, new_path: str, tol: float) -> int:
             f"  {name}" for name, hit in (
                 ("TX-REGRESSION", e["tx_regression"]),
                 ("P99-REGRESSION", e["p99_regression"]),
+                ("SLO-REGRESSION", e.get("slo_regression", False)),
             ) if hit
         )
         print(
