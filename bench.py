@@ -1545,6 +1545,15 @@ def _bench_array_engine(
         dynamic=dynamic, coin_rounds=coin_rounds, tracer=tracer,
     )
     net.run_epochs(1, payload_size=64)  # warm: compile/caches
+    # per-epoch telemetry series (PR 13): BENCH_SERIES=<path.jsonl>
+    # attaches a MetricsLog AFTER the warm epoch (so the series covers
+    # exactly the timed steady-state epochs) with timing fields opted
+    # back in — benches are wall-clock evidence, not replay artifacts
+    series_path = os.environ.get("BENCH_SERIES")
+    if series_path:
+        from hbbft_tpu.obs.timeseries import MetricsLog
+
+        net.metrics_log = MetricsLog(include_timing=True)
     counters = getattr(backend, "counters", None)
     ctr0 = counters.snapshot() if counters is not None else {}
     # post-warm baselines so the row's counters/histograms cover exactly
@@ -1715,6 +1724,14 @@ def _bench_array_engine(
             )
         )
     }
+    if series_path and net.metrics_log is not None:
+        from hbbft_tpu.obs.critpath import gating_from_series
+
+        net.metrics_log.to_jsonl(series_path)
+        gating = gating_from_series(net.metrics_log.rows_list())
+        if gating:
+            row["gating"] = gating
+        row["series"] = series_path
     return row
 
 

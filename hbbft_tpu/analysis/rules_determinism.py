@@ -133,6 +133,12 @@ class DeterminismRule(Rule):
     # observed virtual-time state + the injected rng, so a seeded
     # replay reproduces the exact B trace (and the kill-switch A/B
     # stays bit-identical).
+    # The critpath/timeseries/flight observability trio (PR 13) rides
+    # the same contract: stamps carry caller-provided crank/virtual-time
+    # context, series rows and forensics bundles are pure functions of
+    # the recorded evidence (seeded replay ⇒ bit-identical artifacts).
+    # tracer.py and health.py stay OUT of scope — they legitimately read
+    # wall clocks (spans, heartbeats).
     scope = (
         "hbbft_tpu/protocols/",
         "hbbft_tpu/core/",
@@ -141,6 +147,9 @@ class DeterminismRule(Rule):
         "hbbft_tpu/net/crash.py",
         "hbbft_tpu/traffic/",
         "hbbft_tpu/control/",
+        "hbbft_tpu/obs/critpath.py",
+        "hbbft_tpu/obs/timeseries.py",
+        "hbbft_tpu/obs/flight.py",
     )
 
     def check_module(self, mod: ModuleSource) -> List[Finding]:

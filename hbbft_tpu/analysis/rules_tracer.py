@@ -121,7 +121,17 @@ def _decorator_jit_info(fn: ast.FunctionDef) -> Optional[Tuple[Set[int], Set[str
 @register
 class TracerSafetyRule(Rule):
     rule_id = "tracer-safety"
-    scope = ("hbbft_tpu/engine/", "hbbft_tpu/ops/")
+    # obs/ (PR 13): the critpath/timeseries/flight trio sits on the
+    # engine's hot path (per-output stamps, per-epoch snaps) — a stray
+    # device sync or device_get in a loop there would stall the pipeline
+    # exactly like one in the engine
+    scope = (
+        "hbbft_tpu/engine/",
+        "hbbft_tpu/ops/",
+        "hbbft_tpu/obs/critpath.py",
+        "hbbft_tpu/obs/timeseries.py",
+        "hbbft_tpu/obs/flight.py",
+    )
 
     def check_module(self, mod: ModuleSource) -> List[Finding]:
         findings: List[Finding] = []

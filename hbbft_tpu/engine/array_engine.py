@@ -64,6 +64,7 @@ from __future__ import annotations
 
 import os
 import random
+import time
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Sequence
 
@@ -71,6 +72,7 @@ from hbbft_tpu.core.network_info import NetworkInfo
 from hbbft_tpu.crypto.backend import CryptoBackend, MockBackend
 from hbbft_tpu.crypto.erasure import rs_codec
 from hbbft_tpu.crypto.merkle import MerkleTree, PackedProofs, _depth, validate_proofs
+from hbbft_tpu.obs import critpath as _critpath
 from hbbft_tpu.ops.pipeline import hostpipe_enabled
 from hbbft_tpu.protocols.honey_badger import Batch
 from hbbft_tpu.utils import canonical
@@ -111,6 +113,10 @@ class EpochReport:
     votes_verified: int = 0
     kg_parts_handled: int = 0
     kg_acks_handled: int = 0
+    # wall seconds per engine phase (rbc / coin / ba / decrypt) — the
+    # lockstep engine's critical-path attribution input
+    # (obs/critpath.path_from_phase_seconds)
+    phase_seconds: Optional[Dict[str, float]] = None
 
 
 class ArrayHoneyBadgerNet:
@@ -145,11 +151,16 @@ class ArrayHoneyBadgerNet:
     batch_listeners: Sequence = ()
     contribution_source = None
     batch_size_provider = None
+    #: per-epoch series (obs/timeseries.MetricsLog): when attached, every
+    #: run_epoch appends a row (merged counter deltas, histogram windows,
+    #: live B, the epoch's phase-attributed gate) — environment, not state
+    metrics_log = None
     _SNAPSHOT_ENV_ATTRS = (
         "tracer",
         "batch_listeners",
         "contribution_source",
         "batch_size_provider",
+        "metrics_log",
     )
 
     def __init__(
@@ -278,6 +289,24 @@ class ArrayHoneyBadgerNet:
         # attributed host_seconds total or its unattributed-share gate.
         for cb in self.batch_listeners:
             cb(out)
+        # per-epoch series row (obs/timeseries.py): after the listener
+        # fan-out so mempool/controller updates for this epoch are visible
+        if self.metrics_log is not None:
+            rep = self.reports[-1]
+            gate = _critpath.path_from_phase_seconds(
+                rep.epoch, rep.phase_seconds or {}, cranks=rep.rounds
+            )
+            self.metrics_log.snap(
+                rep.epoch,
+                counters=self.counters.merged_with(self.backend.counters),
+                tracer=self.tracer,
+                controller_b=(
+                    self.batch_size_provider()
+                    if self.batch_size_provider is not None
+                    else None
+                ),
+                gate=gate,
+            )
         return out
 
     def _run_epoch(self, contributions: Dict[Any, bytes]) -> Dict[Any, Batch]:
@@ -286,7 +315,12 @@ class ArrayHoneyBadgerNet:
         tr = self.tracer
         bk = self.backend.buckets
         fast = hostpipe_enabled()
-        t_phase = 0.0
+        # phase wall clocks run unconditionally (~4 reads per epoch): the
+        # per-phase splits feed EpochReport.phase_seconds, the lockstep
+        # critical-path attribution input.  Tracer clock when attached
+        # (keeps spans and splits on one timebase), perf_counter otherwise.
+        clock = tr.clock if tr is not None else time.perf_counter
+        phase_s: Dict[str, float] = {}
         if tr is not None:
             tr.begin(
                 f"epoch:{self.epoch}", cat="epoch",
@@ -294,7 +328,7 @@ class ArrayHoneyBadgerNet:
             )
             tr.begin("subset", cat="subset", epoch=self.epoch)
             tr.begin("rbc", cat="rbc")
-            t_phase = tr.clock()
+        t_phase = clock()
 
         # ------ round 0: encrypt + RS-encode + Merkle-commit + Value -------
         # honey_badger.py propose(): canonical-encode the contribution
@@ -429,10 +463,11 @@ class ArrayHoneyBadgerNet:
             )
         for p in self.ids:
             _require(values[p] == ct_bytes[p], "RBC value mismatch")
+        t_now = clock()
+        phase_s["rbc"] = t_now - t_phase
         if tr is not None:
             # per-proposer RBC instance spans: in the lockstep schedule all
             # N instances cover the same wall interval, one per track
-            t_now = tr.clock()
             for idx, nid in enumerate(self.ids):
                 tr.complete(
                     f"rbc:{idx}", t_phase, t_now, cat="rbc",
@@ -440,7 +475,7 @@ class ArrayHoneyBadgerNet:
                 )
             tr.end()  # rbc
             tr.begin("ba", cat="ba")
-            t_phase = t_now
+        t_phase = t_now
         # subset.py _on_broadcast_output: input true to BA_p. BA round 0:
         # sbv_broadcast.py send_bval → BVal(true) to all.
         self._count_msgs(rep, n * n * (n - 1))  # BVal
@@ -461,8 +496,11 @@ class ArrayHoneyBadgerNet:
         # immediately, no threshold-sign traffic (coin_rounds == 0).  With
         # coin_rounds=R the engine executes R REAL coin rounds first (the
         # split-input schedule where conf_values stays {true, false}).
+        t_coin = clock()
         for r in range(self.coin_rounds):
             self._coin_round(rep, round_no=r)
+        if self.coin_rounds:
+            phase_s["coin"] = clock() - t_coin
         if tr is not None:
             # the deciding round consults the FIXED coin (zero-duration
             # span: no threshold-sign traffic, but the consult is a real
@@ -471,8 +509,9 @@ class ArrayHoneyBadgerNet:
             tr.end()
         self._count_msgs(rep, n * n * (n - 1))  # Term
         rep.rounds += 1
+        t_now = clock()
+        phase_s["ba"] = (t_now - t_phase) - phase_s.get("coin", 0.0)
         if tr is not None:
-            t_now = tr.clock()
             for idx, nid in enumerate(self.ids):
                 tr.complete(
                     f"ba:{idx}", t_phase, t_now, cat="ba",
@@ -481,6 +520,7 @@ class ArrayHoneyBadgerNet:
             tr.end()  # ba
             tr.end()  # subset
             tr.begin("decrypt", cat="decrypt", epoch=self.epoch)
+        t_phase = t_now
 
         # ------ round 7: ciphertext validation + decryption shares ---------
         # honey_badger.py: SubsetOutput::Contribution(p, ct) → spawn
@@ -631,6 +671,8 @@ class ArrayHoneyBadgerNet:
                 _require(tree == bytes(contributions[p]), "decrypt mismatch")
                 decoded[p] = tree
         rep.rounds += 1
+        phase_s["decrypt"] = clock() - t_phase
+        rep.phase_seconds = phase_s
         if tr is not None:
             tr.end()  # decrypt
             tr.end()  # epoch

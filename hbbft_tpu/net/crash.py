@@ -46,6 +46,7 @@ from dataclasses import dataclass
 from typing import TYPE_CHECKING, Any, Dict, List, Optional, Tuple
 
 from hbbft_tpu.core.fault_log import Fault
+from hbbft_tpu.obs import critpath as _critpath
 from hbbft_tpu.utils.snapshot import SnapshotError, load_node, save_node
 
 if TYPE_CHECKING:  # pragma: no cover
@@ -527,6 +528,13 @@ class CrashManager:
         t.sent_cursor = 0
         t.diverged = False
         self._replaying = nid
+        # WAL-replay window: protocol completion stamps fired while the
+        # node catches up attribute to the crash:recovery pseudo-phase —
+        # a restart-gated epoch must name the recovering node, not the
+        # phase the replay happened to re-run.
+        rec = _critpath.active()
+        if rec is not None:
+            rec.begin_recovery(nid)
         try:
             for kind, state, a, b in t.wal:
                 replay_rng.setstate(state)
@@ -542,6 +550,8 @@ class CrashManager:
             return
         finally:
             self._replaying = None
+            if rec is not None:
+                rec.end_recovery()
         if (
             t.diverged
             or t.sent_cursor != len(t.sent)

@@ -50,6 +50,9 @@ from hbbft_tpu.net.virtual_net import (
     Partition,
     VirtualNet,
 )
+from hbbft_tpu.obs import critpath as _critpath
+from hbbft_tpu.obs.flight import FlightRecorder
+from hbbft_tpu.obs.timeseries import MetricsLog, snap_net
 
 
 @dataclass(frozen=True)
@@ -251,6 +254,10 @@ class ScenarioResult:
     #: cell passes iff whatever committed is identical, nothing was
     #: misattributed, and a stall names its cause)
     bounded: bool = False
+    #: flight-recorder forensics bundle (obs/flight.py), present when the
+    #: cell failed and obs was on — tools/scenario_matrix.py --fail-dir
+    #: writes it next to the row dump
+    forensics: Optional[Dict[str, Any]] = None
 
     def row(self) -> Dict[str, Any]:
         """Flat JSON-friendly form for tools/scenario_matrix.py."""
@@ -357,9 +364,12 @@ def run_scenario(
     backend=None,
     scheduler: str = "random",
     crank_limit: int = 5_000_000,
+    obs: bool = True,
 ) -> ScenarioResult:
     """Run one matrix cell; never raises — a starved cell comes back with
-    ``ok=False`` and the why-stalled report naming the attack."""
+    ``ok=False`` and the why-stalled report naming the attack.  With
+    ``obs=True`` a flight recorder rides along and a failed cell carries
+    its forensics bundle on ``result.forensics``."""
     attack = ATTACKS[attack_name]
     schedule = SCHEDULES[schedule_name]
     if f is None:
@@ -371,45 +381,90 @@ def run_scenario(
         attack, schedule, n, f=f, seed=seed, backend=backend,
         scheduler=scheduler, crank_limit=crank_limit,
     )
+    rec = _critpath.CritPathRecorder() if obs else None
+    flight = None
+    if rec is not None:
+        _critpath.activate(rec)
+        net.critpath = rec
+        flight = FlightRecorder(
+            context={
+                "cell": {
+                    "attack": attack_name, "schedule": schedule_name,
+                    "n": n, "f": f, "seed": seed, "epochs": epochs,
+                }
+            }
+        )
+
+    def _frame(e: int) -> None:
+        if rec is None:
+            return
+        events = rec.take()
+        paths = _critpath.paths_from_events(events)
+        if paths:
+            rec.last_path = paths[-1]
+        flight.record(e, events=events)
+
+    def _dump(reason: str) -> None:
+        if rec is None:
+            return
+        if rec.events:
+            _frame(epochs)  # trailing mid-epoch window
+        summary = (result.why or {}).get("summary") or []
+        result.forensics = flight.bundle(
+            reason,
+            why=result.why,
+            faults=result.fault_log,
+            gate_hint=summary[0] if summary else None,
+        )
+
     try:
-        for e in range(epochs):
-            for i in sorted(net.nodes):
-                net.send_input(i, {"from": i, "epoch": e})
-            net.crank_until(
-                lambda nt, e=e: all(
-                    len(node.outputs) >= e + 1 for node in nt.correct_nodes()
-                ),
-                max_cranks=crank_limit,
-            )
-    except CrankError as err:
-        result.error = str(err).splitlines()[0]
-        result.why = err.report
+        try:
+            for e in range(epochs):
+                for i in sorted(net.nodes):
+                    net.send_input(i, {"from": i, "epoch": e})
+                net.crank_until(
+                    lambda nt, e=e: all(
+                        len(node.outputs) >= e + 1
+                        for node in nt.correct_nodes()
+                    ),
+                    max_cranks=crank_limit,
+                )
+                _frame(e)
+        except CrankError as err:
+            result.error = str(err).splitlines()[0]
+            result.why = err.report
+            _collect(result, net, epochs)
+            if schedule.lossy:
+                result.ok = _bounded_degradation_ok(result)
+                result.bounded = result.ok
+            _dump("crank_error")
+            return result
         _collect(result, net, epochs)
-        if schedule.lossy:
+        missing = []
+        faulty_ids = {repr(node.id) for node in net.faulty_nodes()}
+        for kind in attack.expected_faults:
+            landed = any(
+                k == kind and accused in faulty_ids
+                for _, accused, k in result.fault_log
+            )
+            if not landed:
+                missing.append(kind)
+        result.missing_expected = missing
+        result.ok = (
+            result.batches_identical
+            and result.epochs_committed >= epochs
+            and not missing
+            and not result.misattributed
+        )
+        if schedule.lossy and not result.ok:
             result.ok = _bounded_degradation_ok(result)
             result.bounded = result.ok
+        if not result.ok:
+            _dump("verdict_failure")
         return result
-    _collect(result, net, epochs)
-    missing = []
-    faulty_ids = {repr(node.id) for node in net.faulty_nodes()}
-    for kind in attack.expected_faults:
-        landed = any(
-            k == kind and accused in faulty_ids
-            for _, accused, k in result.fault_log
-        )
-        if not landed:
-            missing.append(kind)
-    result.missing_expected = missing
-    result.ok = (
-        result.batches_identical
-        and result.epochs_committed >= epochs
-        and not missing
-        and not result.misattributed
-    )
-    if schedule.lossy and not result.ok:
-        result.ok = _bounded_degradation_ok(result)
-        result.bounded = result.ok
-    return result
+    finally:
+        if rec is not None:
+            _critpath.deactivate()
 
 
 def _bounded_degradation_ok(result: ScenarioResult) -> bool:
@@ -661,6 +716,13 @@ class SoakResult:
     why: Optional[Dict[str, Any]] = None
     stall_named: bool = False
     bounded: bool = False
+    #: observability planes (run_cell obs=True): the per-epoch series
+    #: rows, the run's gating histogram, and — on failure — the flight
+    #: recorder's forensics bundle.  Evidence, NOT state: none of these
+    #: enter fingerprint(), so obs on/off cannot flip a replay verdict.
+    series: List[Dict[str, Any]] = field(default_factory=list)
+    gating: Dict[str, float] = field(default_factory=dict)
+    forensics: Optional[Dict[str, Any]] = None
 
     def fingerprint(self) -> str:
         """Seeded-replay fingerprint: batch sha256 + sorted fault log +
@@ -712,6 +774,7 @@ class SoakResult:
             "traffic_state": self.traffic_state,
             "stall_named": self.stall_named,
             "error": self.error,
+            "gating": self.gating,
         }
 
 
@@ -830,11 +893,37 @@ def _soak_collect(result: SoakResult, net, driver) -> None:
 
 
 def run_cell(
-    cell: Cell, backend=None, crank_limit: int = 5_000_000
+    cell: Cell, backend=None, crank_limit: int = 5_000_000, obs: bool = True
 ) -> SoakResult:
     """Run one composed-gauntlet cell; never raises — a starved cell
     comes back ok=False with the why-stalled report naming the dominant
-    cause (attack, partition, down node, or starved/saturated source)."""
+    cause (attack, partition, down node, or starved/saturated source).
+
+    ``obs=True`` (default) wires the three observability planes: a
+    :class:`~hbbft_tpu.obs.critpath.CritPathRecorder` on the module stamp
+    hook (gating-chain reconstruction per epoch), a per-epoch
+    :class:`~hbbft_tpu.obs.timeseries.MetricsLog` (``result.series``),
+    and a :class:`~hbbft_tpu.obs.flight.FlightRecorder` whose forensics
+    bundle lands on ``result.forensics`` when the cell dies (CrankError,
+    verdict failure, or a ``crash:*`` fault).  None of it enters the
+    replay fingerprint.  Cells run sequentially, so the single
+    process-wide stamp hook is activated around this run only."""
+    rec = _critpath.CritPathRecorder() if obs else None
+    if rec is not None:
+        _critpath.activate(rec)
+    try:
+        return _run_cell(cell, backend, crank_limit, rec)
+    finally:
+        if rec is not None:
+            _critpath.deactivate()
+
+
+def _run_cell(
+    cell: Cell,
+    backend,
+    crank_limit: int,
+    rec: Optional[_critpath.CritPathRecorder],
+) -> SoakResult:
     from hbbft_tpu.protocols.change import Change
     from hbbft_tpu.protocols.honey_badger import EncryptionSchedule
     from hbbft_tpu.traffic.driver import ObjectTrafficDriver
@@ -883,6 +972,60 @@ def run_cell(
             controller=controller,
         )
 
+    series = flight = None
+    all_paths: List[_critpath.EpochCritPath] = []
+    next_frame = [0]
+    if rec is not None:
+        series = MetricsLog()
+        flight = FlightRecorder(context={"cell": cell.to_dict()})
+        net.critpath = rec  # crank/virtual-clock ticks + health gate line
+        net.metrics_log = series
+
+    def _epoch_obs(k: Optional[int] = None) -> None:
+        """Epoch boundary: drain the stamp ring, reconstruct the window's
+        gating chains, snap a series row, and push a flight frame."""
+        if rec is None:
+            return
+        if k is None:
+            k = next_frame[0]
+        next_frame[0] = k + 1
+        events = rec.take()
+        paths = _critpath.paths_from_events(events)
+        if paths:
+            rec.last_path = paths[-1]
+            all_paths.extend(paths)
+        row = snap_net(
+            series,
+            net,
+            k,
+            gate=paths[-1] if paths else None,
+            controller_b=(
+                driver.controller.current_b
+                if driver is not None and driver.controller is not None
+                else None
+            ),
+            mempool_depth=driver.max_depth if driver is not None else None,
+        )
+        flight.record(k, series_row=row, events=events)
+
+    def _obs_finish(reason: Optional[str]) -> None:
+        """Attach the evidence planes to the result; ``reason`` non-None
+        dumps the flight ring as a forensics bundle."""
+        if rec is None:
+            return
+        if rec.events:
+            _epoch_obs()  # trailing window (recovery grace / mid-epoch death)
+        result.series = series.rows_list()
+        result.gating = _critpath.gating_histogram(all_paths)
+        if reason is not None:
+            summary = (result.why or {}).get("summary") or []
+            result.forensics = flight.bundle(
+                reason,
+                why=result.why,
+                faults=result.fault_log,
+                gate_hint=summary[0] if summary else None,
+            )
+
     churn_epochs = set(churn.make(cell.n, cell.epochs))
     # alternating schedule flips so consecutive churn votes name distinct
     # winning changes (tick_tock(1, 0) encrypts every epoch — semantics
@@ -921,6 +1064,7 @@ def run_cell(
                 net.crank_until(
                     lambda nt, k=k: live_done(nt, k), max_cranks=crank_limit
                 )
+            _epoch_obs(k)
         if net.crash is not None:
             # recovery grace: give the last restart room to catch up to
             # the honest maximum before the verdict reads the gate.
@@ -965,6 +1109,7 @@ def run_cell(
         result.why = err.report
         result.stall_named = bool((err.report or {}).get("summary"))
         _soak_collect(result, net, driver)
+        _obs_finish("crank_error")
         if sched.lossy:
             # bounded-degradation contract: a lossy stall passes iff the
             # committed prefix is identical, nothing was misattributed,
@@ -1003,4 +1148,14 @@ def run_cell(
             and result.recovered_in_time
         )
         result.bounded = result.ok
+    reason = None
+    if not result.ok:
+        reason = "verdict_failure"
+    else:
+        # a crash:* fault with a passing verdict (e.g. checkpoint_failed)
+        # still merits the evidence dump — the next session debugs from it
+        crash_kinds = sorted(k for k in result.fault_kinds if k.startswith("crash:"))
+        if crash_kinds:
+            reason = crash_kinds[0]
+    _obs_finish(reason)
     return result

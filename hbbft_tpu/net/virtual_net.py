@@ -202,7 +202,19 @@ class VirtualNet:
     #: RaceTracker) records crank events with causal enqueue edges.
     crank_chooser = None
     race_probe = None
-    _SNAPSHOT_ENV_ATTRS = ("traffic", "crank_chooser", "race_probe")
+    #: critical-path recorder (obs/critpath.CritPathRecorder) and
+    #: per-epoch series (obs/timeseries.MetricsLog) — environment, not
+    #: state: both hold open-ended evidence rings the harness owns, so
+    #: whole-net snapshots drop them and restore falls back to None.
+    critpath = None
+    metrics_log = None
+    _SNAPSHOT_ENV_ATTRS = (
+        "traffic",
+        "crank_chooser",
+        "race_probe",
+        "critpath",
+        "metrics_log",
+    )
     #: class fallback so pre-crash-axis whole-net snapshots restore
     #: (decode sets only serialized attrs); instances always assign it
     crash = None
@@ -368,6 +380,8 @@ class VirtualNet:
                 return None
         self.cranks += 1
         self.now += 1
+        if self.critpath is not None:
+            self.critpath.tick(self.cranks, self.now)
         if self.crank_limit is not None and self.cranks > self.crank_limit:
             raise self._crank_error(f"crank limit {self.crank_limit} exceeded")
 

@@ -15,15 +15,44 @@ Three cooperating pieces, all opt-in and zero-cost when absent (the same
   .why_stalled` report names which BA instances are blocked on which coin
   rounds and which RBC instances lack Echo/Ready quorum.
 
+Three more planes ride on those (PR 16 — span → series → forensics):
+
+* :class:`~hbbft_tpu.obs.critpath.CritPathRecorder` /
+  ``obs/critpath.py`` — per-epoch gating-chain reconstruction from
+  protocol completion events and engine phase stamps (``epoch 12 <-
+  decrypt.combine <- BA(7) coin <- RBC(7)``), with a run-level gating
+  histogram.
+* :class:`~hbbft_tpu.obs.timeseries.MetricsLog` — bounded per-epoch
+  counter-delta/histogram/crash-state series, JSONL-exportable.
+* :class:`~hbbft_tpu.obs.flight.FlightRecorder` — always-on ring of the
+  last K epochs of events + series, dumped as a forensics bundle on
+  failure (``HBBFT_TPU_FLIGHT_EPOCHS``).
+
 Activation: ``NetBuilder.trace(Tracer())`` for the object runtime,
 ``ArrayHoneyBadgerNet(..., tracer=...)``/``net.tracer = ...`` for the
 lockstep engine, ``--trace PATH`` / ``HBBFT_TPU_TRACE=PATH`` on
-``examples/simulation.py``.
+``examples/simulation.py``; ``net/scenarios.run_cell`` wires all three
+new planes by default (``obs=False`` opts out).
 """
 
+from hbbft_tpu.obs.critpath import (
+    PHASES,
+    CritPathRecorder,
+    EpochCritPath,
+    diff_gating,
+    gating_histogram,
+    paths_from_events,
+)
+from hbbft_tpu.obs.flight import (
+    FlightRecorder,
+    summarize_bundle,
+    validate_bundle,
+    write_bundle,
+)
 from hbbft_tpu.obs.health import HealthReporter, render_why_stalled, why_stalled
 from hbbft_tpu.obs.histogram import Histogram
 from hbbft_tpu.obs.hostbuckets import HOST_BUCKETS, HostBuckets
+from hbbft_tpu.obs.timeseries import MetricsLog, snap_net
 from hbbft_tpu.obs.tracer import Tracer
 
 __all__ = [
@@ -34,4 +63,16 @@ __all__ = [
     "HOST_BUCKETS",
     "why_stalled",
     "render_why_stalled",
+    "PHASES",
+    "CritPathRecorder",
+    "EpochCritPath",
+    "paths_from_events",
+    "gating_histogram",
+    "diff_gating",
+    "MetricsLog",
+    "snap_net",
+    "FlightRecorder",
+    "validate_bundle",
+    "summarize_bundle",
+    "write_bundle",
 ]

@@ -303,6 +303,16 @@ def why_stalled(net_or_nodes: Any) -> Dict[str, Any]:
     """
     nodes = getattr(net_or_nodes, "nodes", net_or_nodes)
     report: Dict[str, Any] = {"nodes": {}, "summary": []}
+    # lead with the latest critical-path gate (net.critpath, when the
+    # harness attached a CritPathRecorder): "last epoch gated by BA(3)
+    # coin round 2 on node 7" orients the reader before the per-node
+    # quorum shortfalls below
+    cp = getattr(net_or_nodes, "critpath", None)
+    gate_line = getattr(cp, "gate_line", None)
+    line = gate_line() if callable(gate_line) else None
+    if line:
+        report["gate"] = line
+        report["summary"].append(f"last {line}")
     ctx = _scenario_context(net_or_nodes)
     if ctx is not None:
         report["scenario"] = ctx
@@ -380,6 +390,9 @@ class HealthReporter:
     the nonzero deltas since the previous beat plus a device-time share.
     ``stall_report_fn`` (e.g. ``lambda: why_stalled(net)``) is invoked
     once per stall episode; progress re-arms the detector.
+    ``gate_fn`` (e.g. ``net.critpath.gate_line`` when a critical-path
+    recorder is attached) contributes the latest gating one-liner to
+    every heartbeat and stall record.
     """
 
     def __init__(
@@ -390,11 +403,13 @@ class HealthReporter:
         stall_report_fn: Optional[Callable[[], Dict[str, Any]]] = None,
         sink: Callable[[Dict[str, Any]], None] = _print_sink,
         clock: Callable[[], float] = time.monotonic,
+        gate_fn: Optional[Callable[[], Optional[str]]] = None,
     ) -> None:
         self.interval_s = interval_s
         self.stall_timeout_s = stall_timeout_s
         self.counters_fn = counters_fn
         self.stall_report_fn = stall_report_fn
+        self.gate_fn = gate_fn
         self.sink = sink
         self.clock = clock
         t = clock()
@@ -426,11 +441,22 @@ class HealthReporter:
             "epoch": epoch,
             "msgs": msgs,
         }
+        self._add_gate(record)
         if self.stall_report_fn is not None:
             record["why"] = self.stall_report_fn()
         self.stalled = True
         self.sink(record)
         return record
+
+    def _add_gate(self, record: Dict[str, Any]) -> None:
+        if self.gate_fn is None:
+            return
+        try:
+            line = self.gate_fn()
+        except Exception:  # a heartbeat must never raise on a custom hook
+            return
+        if line:
+            record["gate"] = line
 
     def tick(
         self,
@@ -466,6 +492,7 @@ class HealthReporter:
                 "epoch": epoch,
                 "msgs": msgs,
             }
+            self._add_gate(record)
             if self.stall_report_fn is not None:
                 record["why"] = self.stall_report_fn()
             self.sink(record)
@@ -510,6 +537,7 @@ class HealthReporter:
                 if ovl and dev > 0:
                     beat["overlap_fraction"] = round(ovl / dev, 4)
         beat.update(extra)
+        self._add_gate(beat)
         self.beats.append(beat)
         self.sink(beat)
         return beat

@@ -28,6 +28,7 @@ from hbbft_tpu.core.protocol import ConsensusProtocol
 from hbbft_tpu.core.types import Step, Target, TargetedMessage, absorb_child_step
 from hbbft_tpu.crypto.backend import CryptoBackend
 from hbbft_tpu.crypto.keys import Signature
+from hbbft_tpu.obs import critpath as _critpath
 from hbbft_tpu.protocols.bool_set import BoolMultimap, BoolSet
 from hbbft_tpu.protocols.sbv_broadcast import SbvBroadcast, SbvMessage
 from hbbft_tpu.protocols.threshold_sign import ThresholdSign, ThresholdSignMessage
@@ -79,10 +80,14 @@ class BinaryAgreement(ConsensusProtocol):
         netinfo: NetworkInfo,
         backend: CryptoBackend,
         session_id: bytes,
+        instance: Optional[int] = None,
     ) -> None:
         self.netinfo = netinfo
         self.backend = backend
         self.session_id = session_id
+        # Proposer index when this BA sits inside a Subset (critical-path
+        # attribution label); standalone BAs leave it None.
+        self.instance = instance
         self.round = 0
         self.sbv = SbvBroadcast(netinfo)
         self.received_conf: Dict[Any, BoolSet] = {}
@@ -259,6 +264,13 @@ class BinaryAgreement(ConsensusProtocol):
         # The coin may combine from f+1 peers' shares before our own
         # SBV/Conf phase completes — store it and apply at conf quorum.
         self._coin_value = sig.parity()
+        _critpath.stamp(
+            "coin.reveal",
+            node=self.netinfo.our_id,
+            instance=self.instance,
+            rnd=r,
+            value=self._coin_value,
+        )
         return self._try_apply_coin()
 
     def _try_apply_coin(self) -> Step:
@@ -313,6 +325,13 @@ class BinaryAgreement(ConsensusProtocol):
         if self.decision is not None:
             return Step()
         self.decision = b
+        _critpath.stamp(
+            "ba.decide",
+            node=self.netinfo.our_id,
+            instance=self.instance,
+            rnd=self.round,
+            value=b,
+        )
         step = Step.from_output(b)
         if not self._sent_term:
             self._sent_term = True

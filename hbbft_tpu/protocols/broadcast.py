@@ -27,6 +27,7 @@ from hbbft_tpu.core.protocol import ConsensusProtocol
 from hbbft_tpu.core.types import Step, Target, TargetedMessage
 from hbbft_tpu.crypto.erasure import RSCodec, rs_codec
 from hbbft_tpu.crypto.merkle import MerkleTree, Proof
+from hbbft_tpu.obs import critpath as _critpath
 
 
 @dataclass(frozen=True, slots=True)
@@ -259,5 +260,10 @@ class Broadcast(ConsensusProtocol):
             if length > len(framed) - 4:
                 return Step.from_fault(self.proposer_id, "broadcast:bad_length_prefix")
             self.output = framed[4 : 4 + length]
+            _critpath.stamp(
+                "rbc.output",
+                node=self.netinfo.our_id,
+                instance=self.netinfo.node_index(self.proposer_id),
+            )
             return Step.from_output(self.output)
         return Step()

@@ -26,6 +26,7 @@ from hbbft_tpu.core.protocol import ConsensusProtocol
 from hbbft_tpu.core.types import CryptoWork, Step, absorb_child_step
 from hbbft_tpu.crypto.backend import CryptoBackend
 from hbbft_tpu.crypto.keys import Ciphertext, CryptoError
+from hbbft_tpu.obs import critpath as _critpath
 from hbbft_tpu.protocols.subset import Subset, SubsetOutput
 from hbbft_tpu.protocols.threshold_decrypt import (
     ThresholdDecrypt,
@@ -344,6 +345,12 @@ class HoneyBadger(ConsensusProtocol):
         except (ValueError, IndexError):
             return self._skip_proposer(proposer, "honey_badger:invalid_contribution")
         es.decrypted[proposer] = contribution
+        _critpath.stamp(
+            "decrypt.combine",
+            node=self.netinfo.our_id,
+            instance=self.netinfo.node_index(proposer),
+            epoch=epoch,
+        )
         return self._try_emit_batch()
 
     # -- epoch completion ----------------------------------------------------
@@ -360,6 +367,7 @@ class HoneyBadger(ConsensusProtocol):
         if pending:
             return Step()
         es.batch_emitted = True
+        _critpath.stamp("epoch.commit", node=self.netinfo.our_id, epoch=self.epoch)
         batch = Batch(epoch=self.epoch, contributions=dict(es.decrypted))
         step = Step.from_output(batch)
         return step.extend(self._advance_epoch())

@@ -91,7 +91,12 @@ class Subset(ConsensusProtocol):
             )
             self.proposals[p] = _ProposalState(
                 Broadcast(netinfo, proposer_id=p),
-                BinaryAgreement(netinfo, backend, session_id=ba_session),
+                BinaryAgreement(
+                    netinfo,
+                    backend,
+                    session_id=ba_session,
+                    instance=netinfo.node_index(p),
+                ),
             )
         self._false_inputs_sent = False
         self._done = False

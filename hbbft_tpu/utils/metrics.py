@@ -150,6 +150,16 @@ class Counters:
         cur = self.snapshot()
         return {k: cur[k] - prev.get(k, 0) for k in cur if cur[k] != prev.get(k, 0)}
 
+    def delta(self, since: Dict[str, float]) -> Dict[str, float]:
+        """Snapshot-diff measurement window: every field's change since
+        ``since`` (a previous :meth:`snapshot`), INCLUDING zero-valued
+        fields.  The per-epoch series (obs/timeseries.py) and any other
+        windowed reader use this instead of a mid-run :meth:`reset` —
+        resetting a live, shared Counters skews every run-end aggregate
+        read after it."""
+        cur = self.snapshot()
+        return {k: cur[k] - since.get(k, 0) for k in cur}
+
     def merged_with(self, other: "Counters") -> Dict[str, float]:
         a, b = self.snapshot(), other.snapshot()
         return {k: a[k] + b[k] for k in a}

@@ -1,0 +1,293 @@
+"""Epoch critical-path reconstruction (obs/critpath.py): the stamp
+hook, per-epoch chain walking, crash:recovery attribution, engine
+phase-stamp collapse, run-level gating histograms, and the run_cell
+integration (fingerprint identity obs on/off, seeded-replay identity).
+"""
+
+import pytest
+
+from hbbft_tpu.net.scenarios import Cell, run_cell
+from hbbft_tpu.obs import critpath
+from hbbft_tpu.obs.critpath import (
+    PHASES,
+    CritPathRecorder,
+    EpochCritPath,
+    diff_gating,
+    gating_from_series,
+    gating_histogram,
+    path_from_phase_seconds,
+    paths_from_events,
+    phase_label,
+)
+
+
+# ---------------------------------------------------------------------------
+# the module-level stamp hook
+# ---------------------------------------------------------------------------
+
+
+def test_stamp_is_noop_without_recorder():
+    critpath.deactivate()
+    critpath.stamp("rbc.output", node=0, instance=1)  # must not raise
+    assert critpath.active() is None
+
+
+def test_stamp_reaches_active_recorder_with_crank_context():
+    rec = CritPathRecorder()
+    critpath.activate(rec)
+    try:
+        rec.tick(crank=41, now=7)
+        critpath.stamp("ba.decide", node=2, instance=3, rnd=1, value=True)
+        (ev,) = rec.take()
+        assert ev["phase"] == "ba.decide"
+        assert ev["node"] == 2 and ev["instance"] == 3 and ev["round"] == 1
+        assert ev["crank"] == 41 and ev["now"] == 7
+        assert ev["value"] is True
+    finally:
+        critpath.deactivate()
+    # deactivated again: further stamps don't land
+    critpath.stamp("ba.decide", node=2)
+    assert rec.take() == []
+
+
+def test_unknown_phase_rejected():
+    rec = CritPathRecorder()
+    with pytest.raises(ValueError, match="unknown critpath phase"):
+        rec.stamp("rbc.echo", node=0)
+
+
+def test_ring_bound_counts_drops():
+    rec = CritPathRecorder(capacity=4)
+    for i in range(7):
+        rec.stamp("crank", node=i)
+    assert len(rec.events) == 4
+    assert rec.dropped == 3
+    assert [ev["node"] for ev in rec.take()] == [3, 4, 5, 6]
+
+
+def test_recovery_scope_rebills_stamps():
+    rec = CritPathRecorder()
+    rec.begin_recovery(node=3)
+    rec.stamp("rbc.output", node=3, instance=1)
+    rec.end_recovery()
+    rec.stamp("rbc.output", node=3, instance=2)
+    marker, replayed, live = rec.take()
+    assert marker["phase"] == "crash:recovery" and "via" not in marker
+    assert replayed["phase"] == "crash:recovery"
+    assert replayed["via"] == "rbc.output" and replayed["recovering"] == 3
+    assert live["phase"] == "rbc.output" and "via" not in live
+
+
+# ---------------------------------------------------------------------------
+# chain reconstruction from completion events
+# ---------------------------------------------------------------------------
+
+
+def _ev(phase, crank, node=0, instance=None, epoch=None, rnd=None, **kw):
+    ev = {
+        "phase": phase,
+        "node": node,
+        "instance": instance,
+        "round": rnd,
+        "epoch": epoch,
+        "crank": crank,
+        "now": crank,
+    }
+    ev.update(kw)
+    return ev
+
+
+def test_window_closes_at_last_commit_and_gate_owns_longest_segment():
+    # node 1 commits late: it is the gate node, and its BA decision sat
+    # at crank 40 after an RBC output at crank 5 — BA owns the longest
+    # stretch, so the epoch is gated by BA on node 1
+    events = [
+        _ev("rbc.output", 5, node=1, instance=0),
+        _ev("rbc.output", 6, node=0, instance=0),
+        _ev("ba.decide", 10, node=0, instance=0, rnd=0),
+        _ev("decrypt.combine", 12, node=0, instance=0),
+        _ev("epoch.commit", 14, node=0, epoch=0),
+        _ev("ba.decide", 40, node=1, instance=0, rnd=0),
+        _ev("decrypt.combine", 42, node=1, instance=0),
+        _ev("epoch.commit", 44, node=1, epoch=0),
+    ]
+    (p,) = paths_from_events(events)
+    assert p.epoch == 0
+    assert p.gate_phase == "ba.decide"
+    assert p.gate_node == repr(1)
+    assert p.gate_instance == 0
+    assert p.cranks == 44 - 5
+    # chain reads commit-first
+    assert p.chain[0]["phase"] == "epoch.commit"
+    # contributors sort tightest-slack first: the gate node's last
+    # completion (decrypt at crank 42, 2 cranks behind the commit) leads
+    assert p.contributors[0]["node"] == repr(1)
+    assert p.contributors[0]["slack"] == 2
+    assert all(
+        c["slack"] >= p.contributors[0]["slack"] for c in p.contributors
+    )
+
+
+def test_crash_recovery_overrides_gate_and_names_recovering_node():
+    events = [
+        _ev("rbc.output", 5, node=0, instance=0),
+        _ev(
+            "crash:recovery", 8, node=2,
+            via="rbc.output", recovering=2, instance=0,
+        ),
+        _ev("ba.decide", 10, node=0, instance=0, rnd=0),
+        _ev("epoch.commit", 14, node=0, epoch=3),
+    ]
+    (p,) = paths_from_events(events)
+    assert p.gate_phase == "crash:recovery"
+    assert p.gate_node == repr(2)
+    assert "crash:recovery" in p.one_liner() and "node 2" in p.one_liner()
+
+
+def test_multiple_epochs_partition_into_windows():
+    events = []
+    for ep in range(3):
+        base = ep * 100
+        events += [
+            _ev("rbc.output", base + 1, node=0, instance=0),
+            _ev("ba.decide", base + 4, node=0, instance=0, rnd=0),
+            _ev("decrypt.combine", base + 6, node=0, instance=0),
+            _ev("epoch.commit", base + 8, node=0, epoch=ep),
+        ]
+    paths = paths_from_events(events)
+    assert [p.epoch for p in paths] == [0, 1, 2]
+
+
+def test_path_roundtrips_through_dict():
+    events = [
+        _ev("rbc.output", 1, node=0, instance=0),
+        _ev("epoch.commit", 9, node=0, epoch=0),
+    ]
+    (p,) = paths_from_events(events)
+    q = EpochCritPath.from_dict(p.to_dict())
+    assert q == p
+
+
+# ---------------------------------------------------------------------------
+# the array engine's phase-stamp collapse
+# ---------------------------------------------------------------------------
+
+
+def test_path_from_phase_seconds_gates_longest_phase():
+    p = path_from_phase_seconds(
+        5, {"rbc": 0.02, "ba": 0.05, "coin": 0.01, "decrypt": 0.03}, cranks=9
+    )
+    assert p.epoch == 5 and p.cranks == 9
+    assert p.gate_phase == "ba.decide"
+    assert [ln["phase"] for ln in p.chain] == [
+        "ba.decide", "decrypt.combine", "rbc.output", "coin.reveal",
+    ]
+    assert p.wall_s == pytest.approx(0.11)
+
+
+def test_path_from_phase_seconds_ignores_unknown_keys():
+    p = path_from_phase_seconds(0, {"rbc": 0.1, "warmup": 9.9})
+    assert p.gate_phase == "rbc.output"
+    assert len(p.chain) == 1
+
+
+# ---------------------------------------------------------------------------
+# run-level aggregation
+# ---------------------------------------------------------------------------
+
+
+def test_gating_histogram_and_series_agree():
+    paths = [
+        EpochCritPath(epoch=0, gate_phase="ba.decide"),
+        EpochCritPath(epoch=1, gate_phase="ba.decide"),
+        EpochCritPath(epoch=2, gate_phase="rbc.output"),
+        EpochCritPath(epoch=3, gate_phase="decrypt.combine"),
+    ]
+    hist = gating_histogram(paths)
+    assert hist == {"ba.decide": 0.5, "decrypt.combine": 0.25, "rbc.output": 0.25}
+    rows = [{"epoch": p.epoch, "gate": {"phase": p.gate_phase}} for p in paths]
+    assert gating_from_series(rows) == hist
+    assert gating_histogram([]) == {}
+
+
+def test_diff_gating_flags_shifts_beyond_tol():
+    old = {"ba.decide": 0.6, "rbc.output": 0.4}
+    new = {"ba.decide": 0.35, "rbc.output": 0.45, "coin.reveal": 0.2}
+    shifts = diff_gating(old, new, tol=0.10)
+    assert {s["phase"] for s in shifts} == {"ba.decide", "coin.reveal"}
+    assert diff_gating(old, dict(old)) == []
+
+
+def test_phase_labels_are_human_vocabulary():
+    assert phase_label("rbc.output", 3) == "RBC(3) output"
+    assert phase_label("ba.decide", 7, rnd=2) == "BA(7) decision round 2"
+    assert phase_label("coin.reveal", 1, rnd=0) == "BA(1) coin round 0"
+    assert phase_label("crash:recovery") == "crash:recovery"
+
+
+# ---------------------------------------------------------------------------
+# run_cell integration: attribution + the acceptance identities
+# ---------------------------------------------------------------------------
+
+_CELL = dict(
+    attack="passive", schedule="uniform", churn="none", traffic="none",
+    n=4, epochs=6, seed=2,
+)
+
+
+def test_run_cell_attributes_gates_and_clears_hook():
+    r = run_cell(Cell(crash="none", **_CELL))
+    assert r.ok, r.error
+    assert r.gating and abs(sum(r.gating.values()) - 1.0) < 0.01
+    assert set(r.gating) <= set(PHASES)
+    assert len(r.series) >= 6
+    assert all("gate" in row for row in r.series if row["epoch"] < 6)
+    # the module hook must not leak past the run
+    assert critpath.active() is None
+
+
+def test_restart_epoch_gated_by_crash_recovery():
+    # crash-axis attribution: the epoch that replays a WAL is billed to
+    # the crash:recovery pseudo-phase, naming the recovering node
+    r = run_cell(
+        Cell(crash="one_restart", **dict(_CELL, epochs=10, seed=4))
+    )
+    assert r.ok, r.error
+    assert r.restarts == 1
+    assert "crash:recovery" in r.gating, r.gating
+    gates = [
+        row["gate"] for row in r.series
+        if row.get("gate", {}).get("phase") == "crash:recovery"
+    ]
+    assert gates and gates[0]["node"] is not None
+
+
+def test_fingerprint_identical_with_obs_off():
+    cell = Cell(crash="one_restart", **dict(_CELL, epochs=10, seed=4))
+    on, off = run_cell(cell), run_cell(cell, obs=False)
+    assert on.ok and off.ok
+    assert on.fingerprint() == off.fingerprint()
+    assert off.series == [] and off.gating == {}
+
+
+def test_series_and_gating_replay_bit_identically():
+    cell = Cell(crash="one_restart", **dict(_CELL, epochs=10, seed=4))
+    a, b = run_cell(cell), run_cell(cell)
+    assert a.series == b.series
+    assert a.gating == b.gating
+
+
+def test_why_stalled_leads_with_gate_line():
+    from hbbft_tpu.obs.health import why_stalled
+
+    class FakeNet:
+        nodes = {}
+        critpath = CritPathRecorder()
+
+    FakeNet.critpath.last_path = EpochCritPath(
+        epoch=9, gate_phase="coin.reveal", gate_instance=2,
+        gate_node=repr(1), gate_round=3,
+    )
+    report = why_stalled(FakeNet())
+    assert report["gate"] == "epoch 9 gated by BA(2) coin round 3 on node 1"
+    assert report["summary"][0] == f"last {report['gate']}"

@@ -1,0 +1,197 @@
+"""Failure flight recorder (obs/flight.py): the evidence ring, bundle
+structure and validation, the auto-dump on failing runs (naming the
+injected fault), checkpoint detachment, and seeded-replay identity of
+the dumped bundles."""
+
+import json
+
+import pytest
+
+from hbbft_tpu.net.scenarios import Cell, run_cell
+from hbbft_tpu.obs.flight import (
+    DEFAULT_FLIGHT_EPOCHS,
+    FLIGHT_EPOCHS_ENV,
+    FlightRecorder,
+    flight_epochs,
+    load_bundle,
+    summarize_bundle,
+    validate_bundle,
+    write_bundle,
+)
+
+
+def _commit_events(epoch, base):
+    # a crank tick opens the window, RBC lands 8 cranks later (the
+    # longest stretch — it gates), the commit closes 1 crank after
+    return [
+        {"phase": "crank", "node": None, "instance": None, "round": None,
+         "epoch": None, "crank": base, "now": base},
+        {"phase": "rbc.output", "node": 0, "instance": 0, "round": None,
+         "epoch": None, "crank": base + 8, "now": base + 8},
+        {"phase": "epoch.commit", "node": 0, "instance": None, "round": None,
+         "epoch": epoch, "crank": base + 9, "now": base + 9},
+    ]
+
+
+def _filled(epochs=12, ring=None):
+    fr = FlightRecorder(epochs=ring, context={"cell": {"n": 4, "seed": 1}})
+    for e in range(epochs):
+        fr.record(e, series_row={"epoch": e}, events=_commit_events(e, e * 100))
+    return fr
+
+
+# ---------------------------------------------------------------------------
+# the ring
+# ---------------------------------------------------------------------------
+
+
+def test_ring_keeps_last_k_epochs():
+    fr = _filled(epochs=12, ring=4)
+    assert [f["epoch"] for f in fr.frames] == [8, 9, 10, 11]
+    assert fr.recorded == 12
+
+
+def test_ring_size_from_env(monkeypatch):
+    monkeypatch.delenv(FLIGHT_EPOCHS_ENV, raising=False)
+    assert flight_epochs() == DEFAULT_FLIGHT_EPOCHS
+    monkeypatch.setenv(FLIGHT_EPOCHS_ENV, "3")
+    assert flight_epochs() == 3
+    assert FlightRecorder().epochs == 3
+    monkeypatch.setenv(FLIGHT_EPOCHS_ENV, "junk")
+    assert flight_epochs() == DEFAULT_FLIGHT_EPOCHS
+    monkeypatch.setenv(FLIGHT_EPOCHS_ENV, "-2")
+    assert flight_epochs() == DEFAULT_FLIGHT_EPOCHS
+
+
+# ---------------------------------------------------------------------------
+# bundles
+# ---------------------------------------------------------------------------
+
+
+def test_bundle_is_valid_and_reconstructs_gates():
+    doc = _filled(ring=4).bundle("verdict_failure")
+    assert validate_bundle(doc) == []
+    cp = doc["critical_path"]
+    assert cp["gate"] == "epoch 11 gated by RBC(0) output on node 0"
+    assert [p["epoch"] for p in cp["paths"]] == [8, 9, 10, 11]
+    assert cp["gating"] == {"rbc.output": 1.0}
+
+
+def test_gate_hint_used_when_no_commits_in_window():
+    fr = FlightRecorder(epochs=2)
+    fr.record(0, events=[{"phase": "crank", "crank": 1, "now": 1}])
+    doc = fr.bundle("crank_error", gate_hint="BA(2) short of coin shares")
+    assert doc["critical_path"]["gate"] == "BA(2) short of coin shares"
+    assert doc["critical_path"]["paths"] == []
+
+
+def test_write_load_roundtrip(tmp_path):
+    doc = _filled(ring=2).bundle(
+        "crank_error", why={"summary": ["stuck"]}, faults=[(0, 1, "crash:x")]
+    )
+    path = write_bundle(doc, str(tmp_path / "b.forensics.json"))
+    loaded = load_bundle(path)
+    assert validate_bundle(loaded) == []
+    assert loaded["reason"] == "crank_error"
+    assert loaded["faults"] == [[0, 1, "crash:x"]]
+
+
+def test_validate_rejects_malformed_bundles():
+    good = _filled(ring=2).bundle("crank_error")
+    assert validate_bundle("nope") == ["bundle is not a JSON object"]
+    missing = {k: v for k, v in good.items() if k != "frames"}
+    assert validate_bundle(missing) == ["missing key 'frames'"]
+    bad = json.loads(json.dumps(good))
+    bad["frames"] = [{"epoch": 5}, {"epoch": 3}]
+    assert any("not monotonic" in e for e in validate_bundle(bad))
+    bad = json.loads(json.dumps(good))
+    bad["critical_path"]["gating"] = {"rbc.echo": 1.0}
+    assert any("not in critpath.PHASES" in e for e in validate_bundle(bad))
+    bad = json.loads(json.dumps(good))
+    bad["critical_path"]["gating"] = {"rbc.output": 0.4}
+    assert any("sum to" in e for e in validate_bundle(bad))
+
+
+def test_summary_lines_name_reason_and_gate():
+    doc = _filled(ring=4).bundle(
+        "verdict_failure", faults=[(0, 2, "crash:replay_divergence")]
+    )
+    lines = summarize_bundle(doc)
+    assert "reason='verdict_failure'" in lines[0]
+    assert any("gate: epoch 11 gated by" in ln for ln in lines)
+    assert any("fault crash:replay_divergence: 1" in ln for ln in lines)
+
+
+# ---------------------------------------------------------------------------
+# run_cell integration: the auto-dump
+# ---------------------------------------------------------------------------
+
+_FAIL_CELL = Cell(
+    attack="equivocate", schedule="partition_heal", churn="era_flip",
+    crash="one_restart", traffic="one_x", n=5, epochs=12, seed=3,
+)
+
+
+def test_failing_cell_autodumps_bundle_naming_injected_fault():
+    # starve the crank budget just past the crash/restart (the soak
+    # --smoke-fail calibration): the run dies on CrankError and the
+    # flight recorder's bundle must name the injected fault's phase
+    r = run_cell(_FAIL_CELL, crank_limit=4200)
+    assert not r.ok
+    assert r.forensics is not None
+    assert validate_bundle(r.forensics) == []
+    assert r.forensics["reason"] == "crank_error"
+    assert "crash:recovery" in r.forensics["critical_path"]["gating"]
+    assert any(
+        p["gate_phase"] == "crash:recovery"
+        for p in r.forensics["critical_path"]["paths"]
+    )
+
+
+def test_passing_cell_emits_no_bundle():
+    r = run_cell(
+        Cell(
+            attack="passive", schedule="uniform", churn="none",
+            crash="none", traffic="none", n=4, epochs=6, seed=2,
+        )
+    )
+    assert r.ok and r.forensics is None
+
+
+def test_bundle_replays_bit_identically():
+    a = run_cell(_FAIL_CELL, crank_limit=4200)
+    b = run_cell(_FAIL_CELL, crank_limit=4200)
+    dump = lambda r: json.dumps(r.forensics, sort_keys=True, default=repr)
+    assert dump(a) == dump(b)
+
+
+def test_snapshot_detaches_obs_attrs_but_live_ring_survives():
+    # whole-net checkpoint taken mid-run: critpath/metrics_log are
+    # environment (evidence collectors), not consensus state — the
+    # snapshot drops them, the restored net boots without them, and the
+    # ORIGINAL net's recorder keeps its ring intact
+    from hbbft_tpu.crypto.backend import MockBackend
+    from hbbft_tpu.net.virtual_net import NetBuilder
+    from hbbft_tpu.obs.critpath import CritPathRecorder
+    from hbbft_tpu.obs.timeseries import MetricsLog
+    from hbbft_tpu.protocols.queueing_honey_badger import (
+        QueueingHoneyBadgerBuilder,
+    )
+    from hbbft_tpu.protocols.sender_queue import SenderQueue
+    from hbbft_tpu.utils.snapshot import load_node, save_node
+
+    def make(ni, be, rng):
+        return SenderQueue(
+            QueueingHoneyBadgerBuilder(ni, be, rng).batch_size(3).build()
+        )
+
+    net = (
+        NetBuilder(range(4)).backend(MockBackend()).using(make).build(seed=5)
+    )
+    net.critpath = CritPathRecorder()
+    net.metrics_log = MetricsLog()
+    net.critpath.stamp("crank", node=0)
+    net.metrics_log.snap(0)
+    restored = load_node(save_node(net), MockBackend())
+    assert restored.critpath is None and restored.metrics_log is None
+    assert len(net.critpath.events) == 1 and len(net.metrics_log) == 1

@@ -62,12 +62,21 @@ def test_event_log_capacity_bound():
     assert list(log.events)[-1]["i"] == 249
 
 
-def test_counters_reset():
+def test_counters_delta_measurement_window():
+    # delta() is the windowed-measurement replacement for a mid-run
+    # reset(): every field's change since a snapshot, zeros INCLUDED,
+    # while the live counters stay monotonic (a reset on a shared
+    # backend would skew every run-end aggregate read after it)
     c = Counters()
     c.pairing_checks = 7
     c.device_seconds = 1.25
-    c.reset()
-    assert c.snapshot() == Counters().snapshot()
+    since = c.snapshot()
+    c.pairing_checks += 3
+    d = c.delta(since)
+    assert d["pairing_checks"] == 3
+    assert d["device_seconds"] == 0.0
+    assert set(d) == set(Counters().snapshot())
+    assert c.pairing_checks == 10 and c.device_seconds == 1.25
 
 
 def test_counters_diff_and_merge():

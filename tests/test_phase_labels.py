@@ -1,0 +1,119 @@
+"""Static phase-label registry guard: the critpath phase vocabulary is
+CLOSED.  Every ``stamp(...)`` call site across the protocols, engine,
+and net layers must pass a literal phase from ``critpath.PHASES``
+(checked by AST walk, so a typo'd or drifted label fails here instead of
+raising mid-soak), every phase bills exactly one tracer span category,
+and the dependency-free inline twins in ``tools/trace_report.py`` (which
+must not import the package) stay pinned to the registry."""
+
+import ast
+from pathlib import Path
+
+from hbbft_tpu.obs import critpath, flight
+from tools import trace_report
+
+REPO = Path(__file__).resolve().parent.parent
+
+#: every module that may stamp critpath phases (the AST sweep below
+#: walks these whole directories, so a NEW stamp call site is guarded
+#: automatically)
+STAMP_SCOPES = ("hbbft_tpu/protocols", "hbbft_tpu/engine", "hbbft_tpu/net",
+                "hbbft_tpu/obs")
+
+
+def _stamp_literals():
+    """(path, lineno, literal) for every ``stamp("...")``-shaped call —
+    plain ``stamp(...)``, ``_critpath.stamp(...)``, ``rec.stamp(...)``,
+    ``critpath.stamp(...)`` — with a string-literal first argument."""
+    out = []
+    for scope in STAMP_SCOPES:
+        for path in sorted((REPO / scope).rglob("*.py")):
+            tree = ast.parse(path.read_text(), filename=str(path))
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                fn = node.func
+                name = (
+                    fn.id if isinstance(fn, ast.Name)
+                    else fn.attr if isinstance(fn, ast.Attribute)
+                    else None
+                )
+                if name != "stamp" or not node.args:
+                    continue
+                arg = node.args[0]
+                if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                    out.append((str(path.relative_to(REPO)), node.lineno, arg.value))
+    return out
+
+
+def test_every_stamp_call_site_uses_a_registered_phase():
+    sites = _stamp_literals()
+    # the protocol seams must actually be instrumented: RBC decode, BA
+    # decision, coin reveal, decrypt combine, batch commit all stamp
+    stamped = {phase for _, _, phase in sites}
+    assert {
+        "rbc.output", "ba.decide", "coin.reveal",
+        "decrypt.combine", "epoch.commit",
+    } <= stamped, sorted(stamped)
+    bad = [s for s in sites if s[2] not in critpath.PHASES]
+    assert not bad, f"unregistered phase literals: {bad}"
+
+
+def test_phase_registry_is_closed_and_total():
+    assert len(critpath.PHASES) == len(set(critpath.PHASES))
+    # every phase bills exactly one tracer span category
+    assert set(critpath.PHASE_SPAN_CATS) == set(critpath.PHASES)
+    # the engine's phase-stamp keys resolve into the registry
+    assert set(critpath._ENGINE_PHASES.values()) <= set(critpath.PHASES)
+
+
+def test_trace_report_inline_twins_stay_pinned():
+    # tools/trace_report.py is dependency-free by contract (its helpers
+    # import into the test suite without hbbft_tpu), so it carries
+    # COPIES of the registry — this is the cross-check that keeps them
+    # from drifting
+    assert trace_report.CRITPATH_PHASES == critpath.PHASES
+    assert trace_report.SPAN_CAT_PHASES == {
+        cat: phase for phase, cat in critpath.PHASE_SPAN_CATS.items()
+    }
+    assert trace_report.REQUIRED_FORENSICS_KEYS == flight.REQUIRED_BUNDLE_KEYS
+
+
+def test_trace_report_imports_nothing_from_the_package():
+    tree = ast.parse((REPO / "tools" / "trace_report.py").read_text())
+    for node in ast.walk(tree):
+        mods = []
+        if isinstance(node, ast.Import):
+            mods = [a.name for a in node.names]
+        elif isinstance(node, ast.ImportFrom):
+            mods = [node.module or ""]
+        for m in mods:
+            assert not m.startswith("hbbft_tpu"), (
+                f"trace_report.py imports {m}: it must stay dependency-free"
+            )
+
+
+def test_forensics_validators_agree():
+    # the inline validator and obs/flight.validate_bundle must render
+    # the same verdict on the same bundles
+    fr = flight.FlightRecorder(epochs=2)
+    fr.record(0, events=[
+        {"phase": "rbc.output", "node": 0, "instance": 0, "round": None,
+         "epoch": None, "crank": 1, "now": 1},
+        {"phase": "epoch.commit", "node": 0, "instance": None, "round": None,
+         "epoch": 0, "crank": 5, "now": 5},
+    ])
+    good = fr.bundle("verdict_failure")
+    assert flight.validate_bundle(good) == []
+    assert trace_report.validate_forensics(good) == []
+    bad = dict(good)
+    bad["critical_path"] = {
+        "gate": None, "gating": {"rbc.echo": 1.0}, "paths": [],
+    }
+    assert bool(flight.validate_bundle(bad)) == bool(
+        trace_report.validate_forensics(bad)
+    )
+    del bad["frames"]
+    assert bool(flight.validate_bundle(bad)) == bool(
+        trace_report.validate_forensics(bad)
+    )

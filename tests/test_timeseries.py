@@ -1,0 +1,124 @@
+"""Per-epoch telemetry series (obs/timeseries.py MetricsLog): counter
+deltas over snapshots (never a mid-run reset), timing exclusion for
+replay identity, histogram windows, host-bucket splits, the ring bound,
+and the JSONL export."""
+
+import json
+
+from hbbft_tpu.obs.critpath import EpochCritPath
+from hbbft_tpu.obs.timeseries import MetricsLog, snap_net
+
+
+def test_rows_carry_counter_deltas_not_totals():
+    log = MetricsLog()
+    log.snap(0, counters={"cranks": 10, "messages_delivered": 100})
+    log.snap(1, counters={"cranks": 25, "messages_delivered": 100})
+    r0, r1 = log.rows_list()
+    assert r0["counters"] == {"cranks": 10, "messages_delivered": 100}
+    # zero deltas are elided; the underlying counters stayed monotonic
+    assert r1["counters"] == {"cranks": 15}
+
+
+def test_timing_fields_excluded_by_default():
+    log = MetricsLog()
+    log.snap(0, counters={"cranks": 5, "device_seconds": 1.25})
+    assert log.rows_list()[0]["counters"] == {"cranks": 5}
+    timed = MetricsLog(include_timing=True)
+    timed.snap(0, counters={"cranks": 5, "device_seconds": 1.25})
+    assert timed.rows_list()[0]["counters"] == {
+        "cranks": 5, "device_seconds": 1.25,
+    }
+
+
+def test_host_buckets_split_out():
+    log = MetricsLog(include_timing=True)
+    log.snap(
+        0,
+        counters={"host_bucket_staging": 0.5, "host_bucket_other": 0.1, "cranks": 1},
+    )
+    row = log.rows_list()[0]
+    assert row["host_buckets"] == {"staging": 0.5, "other": 0.1}
+    assert row["counters"] == {"cranks": 1}
+
+
+def test_hist_windows_are_deltas():
+    class FakeTracer:
+        def __init__(self):
+            self.summary = {}
+
+        def hist_summary(self):
+            return self.summary
+
+    tr = FakeTracer()
+    log = MetricsLog()
+    tr.summary = {"dispatch_batch_items": {"count": 4, "p50": 8.0}}
+    log.snap(0, tracer=tr)
+    tr.summary = {"dispatch_batch_items": {"count": 4, "p50": 8.0}}
+    log.snap(1, tracer=tr)  # no new samples: window elided
+    tr.summary = {"dispatch_batch_items": {"count": 9, "p50": 16.0}}
+    log.snap(2, tracer=tr)
+    r0, r1, r2 = log.rows_list()
+    assert r0["hist"]["dispatch_batch_items"]["window_count"] == 4
+    assert "hist" not in r1
+    assert r2["hist"]["dispatch_batch_items"]["window_count"] == 5
+
+
+def test_gate_normalized_from_path_or_dict():
+    log = MetricsLog()
+    p = EpochCritPath(
+        epoch=0, gate_phase="ba.decide", gate_instance=2,
+        gate_node=repr(1), gate_round=3, cranks=40,
+    )
+    log.snap(0, gate=p)
+    log.snap(1, gate={"phase": "rbc.output", "instance": 0, "cranks": 9})
+    r0, r1 = log.rows_list()
+    assert r0["gate"] == {
+        "phase": "ba.decide", "instance": 2, "node": repr(1),
+        "round": 3, "cranks": 40,
+    }
+    assert r1["gate"]["phase"] == "rbc.output" and r1["gate"]["cranks"] == 9
+
+
+def test_ring_bound_and_dropped():
+    log = MetricsLog(capacity=3)
+    for e in range(5):
+        log.snap(e)
+    assert len(log) == 3
+    assert log.dropped == 2
+    assert [r["epoch"] for r in log.rows_list()] == [2, 3, 4]
+    assert log.last()["epoch"] == 4
+
+
+def test_jsonl_roundtrip(tmp_path):
+    log = MetricsLog()
+    log.snap(0, counters={"cranks": 3}, controller_b=16, mempool_depth=40)
+    path = str(tmp_path / "series.jsonl")
+    log.to_jsonl(path)
+    with open(path) as f:
+        rows = [json.loads(line) for line in f]
+    assert rows == log.rows_list()
+    assert rows[0]["b"] == 16 and rows[0]["mempool"] == 40
+
+
+def test_snap_net_duck_typed():
+    class FakeCrash:
+        def stats(self):
+            return {"crashes": 2, "restarts": 1}
+
+    class FakeNet:
+        crash = FakeCrash()
+        cranks = 120
+        now = 60
+
+        def metrics(self):
+            return {"cranks": 120}
+
+        def down_node_ids(self):
+            return [3]
+
+    log = MetricsLog()
+    row = snap_net(log, FakeNet(), 7, controller_b=8, mempool_depth=5)
+    assert row["epoch"] == 7
+    assert row["crash"] == {"crashes": 2, "restarts": 1, "down": [repr(3)]}
+    assert row["cranks"] == 120 and row["now"] == 60
+    assert row["b"] == 8 and row["mempool"] == 5

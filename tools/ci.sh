@@ -15,6 +15,13 @@
 #   3. tools/soak.py --smoke — one composed-gauntlet cell (equivocator x
 #      partition-heal x churn x crash+restart x 1x traffic), run twice,
 #      fingerprint-stable, ~2 s deterministic.
+#   4. the forensics round-trip — tools/soak.py --smoke-fail starves the
+#      smoke cell's crank budget so it fails mid-gauntlet just after the
+#      crash/restart, asserts the flight recorder auto-dumped a valid
+#      bundle naming the injected fault's phase, then
+#      tools/trace_report.py --forensics re-validates the written bundle
+#      dependency-free (the two validators are inline twins; the guard
+#      test pins them against each other).
 #
 # Output is deterministic (lint findings are sorted; the explorer's
 # run/class/prune counts and the soak cell's fingerprint are seeded), so
@@ -37,6 +44,12 @@ echo "== ci: schedule-space race explorer (smoke sweep) =="
 
 echo "== ci: composed-gauntlet soak (smoke cell) =="
 "$PY" tools/soak.py --smoke || rc=1
+
+echo "== ci: forensics round-trip (flight-recorder dump + re-validate) =="
+FDIR="${TMPDIR:-/tmp}/hbbft_ci_forensics"
+rm -rf "$FDIR"
+"$PY" tools/soak.py --smoke-fail --fail-dir "$FDIR" || rc=1
+"$PY" tools/trace_report.py --forensics "$FDIR"/*.forensics.json || rc=1
 
 if [ "$rc" -ne 0 ]; then
     echo "ci: FAILED"

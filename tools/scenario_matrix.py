@@ -55,6 +55,11 @@ def main(argv=None) -> int:
         "under partition_heal) instead of the matrix",
     )
     p.add_argument("--json", default=None, help="write rows to this path")
+    p.add_argument(
+        "--fail-dir", default=None,
+        help="write each failed cell's forensics bundle (flight-recorder "
+        "dump) here as <attack>x<schedule>@n<N>.forensics.json",
+    )
     args = p.parse_args(argv)
 
     if args.n100:
@@ -103,6 +108,16 @@ def main(argv=None) -> int:
                 print(f"    missing expected faults: {r.missing_expected}")
             if r.misattributed:
                 print(f"    misattributed: {r.misattributed[:5]}")
+            if args.fail_dir and r.forensics is not None:
+                from hbbft_tpu.obs.flight import write_bundle
+
+                os.makedirs(args.fail_dir, exist_ok=True)
+                bpath = os.path.join(
+                    args.fail_dir,
+                    f"{r.attack}x{r.schedule}@n{r.n}.forensics.json",
+                )
+                write_bundle(r.forensics, bpath)
+                print(f"    forensics bundle -> {bpath}")
     kinds: dict = {}
     for r in results:
         for k, c in r.fault_kinds.items():

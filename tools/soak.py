@@ -83,6 +83,12 @@ DEFAULT_SUITE = (
 #: x churn x one crash+restart x 1x traffic at N=16, >=200 epochs
 FLAGSHIP = "equivocate:partition_heal:era_flip:one_restart:one_x"
 
+#: --smoke-fail crank budget: the smoke cell (n=5, 12 epochs, seed 3)
+#: runs ~9000 cranks with its injected restart at crank ~3770; cutting
+#: at 4200 kills the run deterministically just AFTER the restart, so
+#: the auto-dumped forensics bundle's window names the recovery.
+SMOKE_FAIL_CRANKS = 4200
+
 
 def parse_cell_spec(spec: str, n: int, epochs: int, seed: int,
                     batch_size: int) -> Cell:
@@ -123,6 +129,10 @@ def run_one(cell: Cell, clean_p99: dict, crank_limit: int) -> dict:
     t0 = time.perf_counter()
     r = run_cell(cell, crank_limit=crank_limit)
     row = r.row()
+    if r.forensics is not None:
+        # hidden key: write_failed writes it next to the replay record;
+        # stripped before any JSON row dump
+        row["_forensics"] = r.forensics
     row["wall_s"] = round(time.perf_counter() - t0, 3)
     row["p99_ok"] = True
     if r.commit_p99 and cell.traffic != "none":
@@ -157,11 +167,17 @@ def run_race_cex(path: str) -> dict:
     }
 
 
-def write_failed(fail_dir: str, cell: Cell, row: dict) -> str:
+def write_failed(fail_dir: str, cell: Cell, row: dict):
     """A replayable failed-cell record: the cell (with its seed) + the
-    observed fingerprint — everything --replay needs."""
+    observed fingerprint — everything --replay needs.  When the run
+    auto-dumped a forensics bundle (row["_forensics"], from the flight
+    recorder) it lands next to the record as <cell>.forensics.json.
+    Returns (record_path, bundle_path_or_None)."""
+    from hbbft_tpu.obs.flight import write_bundle
+
     p = Path(fail_dir)
     p.mkdir(parents=True, exist_ok=True)
+    bundle = row.pop("_forensics", None)
     out = p / f"{cell.cell_id()}.json"
     with open(out, "w", encoding="utf-8") as f:
         json.dump(
@@ -169,7 +185,11 @@ def write_failed(fail_dir: str, cell: Cell, row: dict) -> str:
             f, indent=2, sort_keys=True, default=repr,
         )
         f.write("\n")
-    return str(out)
+    bpath = None
+    if bundle is not None:
+        bpath = str(p / f"{cell.cell_id()}.forensics.json")
+        write_bundle(bundle, bpath)
+    return str(out), bpath
 
 
 def replay_record(path: str, crank_limit: int) -> int:
@@ -195,6 +215,9 @@ def main(argv=None) -> int:
                     help="cell specs attack:schedule[:churn[:crash[:traffic]]]")
     ap.add_argument("--smoke", action="store_true",
                     help="one fast composed cell, run twice, fingerprint-stable (CI)")
+    ap.add_argument("--smoke-fail", action="store_true",
+                    help="kill the smoke cell mid-flight (deterministic crank cut) "
+                         "and gate on the auto-dumped forensics bundle (CI)")
     ap.add_argument("--flagship", action="store_true",
                     help="the N=16 x 200-epoch acceptance cell, two seeds (slow)")
     ap.add_argument("--n", type=int, default=5)
@@ -213,6 +236,36 @@ def main(argv=None) -> int:
 
     if args.replay:
         return replay_record(args.replay, args.crank_limit)
+
+    if args.smoke_fail:
+        # the forensics round-trip smoke: a seeded cell dies at a pinned
+        # crank (after its injected restart), must auto-emit a valid
+        # bundle, and the record+bundle land in --fail-dir.  Transcript
+        # is deterministic (no wall times) — ci.sh asserts on it.
+        from hbbft_tpu.obs.flight import validate_bundle
+
+        cell = parse_cell_spec(FLAGSHIP, n=5, epochs=12, seed=3, batch_size=3)
+        r = run_cell(cell, crank_limit=SMOKE_FAIL_CRANKS)
+        row = r.row()
+        if r.forensics is not None:
+            row["_forensics"] = r.forensics
+        errs = (
+            validate_bundle(r.forensics)
+            if r.forensics is not None
+            else ["no forensics bundle emitted"]
+        )
+        rec, bpath = write_failed(args.fail_dir, cell, row)
+        gate = (r.forensics or {}).get("critical_path", {}).get("gate")
+        print(
+            f"soak: smoke-fail {cell.cell_id()} failed={not r.ok} "
+            f"bundle={'valid' if not errs else 'INVALID'} gate={gate!r}"
+        )
+        print(f"soak:      replay record -> {rec}")
+        if bpath:
+            print(f"soak:      forensics bundle -> {bpath}")
+        for e in errs:
+            print(f"soak:      bundle error: {e}")
+        return 0 if (not r.ok and bpath and not errs) else 1
 
     rows = []
     rc = 0
@@ -276,12 +329,17 @@ def main(argv=None) -> int:
             rc = 1
             if "fingerprint" in row:
                 cell = Cell.from_dict({k: row[k] for k in Cell.__dataclass_fields__ if k in row})
-                rec = write_failed(args.fail_dir, cell, row)
+                rec, bpath = write_failed(args.fail_dir, cell, row)
                 print(f"soak:      replay record -> {rec}")
+                if bpath:
+                    print(f"soak:      forensics bundle -> {bpath}")
 
     if args.json:
+        # hidden evidence keys (full forensics bundles) stay out of the
+        # row dump — they live as standalone .forensics.json files
+        slim = [{k: v for k, v in row.items() if not k.startswith("_")} for row in rows]
         with open(args.json, "w", encoding="utf-8") as f:
-            json.dump({"rows": rows}, f, indent=2, sort_keys=True, default=repr)
+            json.dump({"rows": slim}, f, indent=2, sort_keys=True, default=repr)
             f.write("\n")
     print(f"soak: {sum(1 for r in rows if r['ok'])}/{len(rows)} cells ok")
     return rc

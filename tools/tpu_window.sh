@@ -129,8 +129,12 @@ do_n100() {
   # evidence comes from n16_churn / n32_churn (batched DKG + native
   # hash kernel), and the FULL 10-epoch+churn shape runs LAST as
   # n100_churn (~1.5 h era change since the native hash landed).
+  # BENCH_SERIES (PR 13): per-epoch telemetry rows land next to the
+  # snapshot so post-window analysis can re-gate the epochs
+  # (tools/trace_report.py --critical-path "$ART/series_n100.jsonl")
   HBBFT_TPU_FQ_IMPL=rns BENCH_ONLY=array_n100 BENCH_ARRAY_BACKEND=tpu \
     BENCH_ARRAY_EPOCHS=10 BENCH_ARRAY_CHURN=0 \
+    BENCH_SERIES="$ART/series_n100.jsonl" \
     timeout 7200 python bench.py
 }
 do_matrix_rns_a()  { HBBFT_TPU_FQ_IMPL=rns  BENCH_ONLY=$MATRIX_ONLY timeout 1800 python bench.py; }
@@ -153,12 +157,14 @@ do_host_ab() {
   # the per-bucket host split lands on each row (host_buckets field).
   HBBFT_TPU_FQ_IMPL=rns BENCH_ONLY=array_n100 BENCH_ARRAY_BACKEND=tpu \
     BENCH_ARRAY_EPOCHS=3 BENCH_ARRAY_CHURN=0 \
+    BENCH_SERIES="$ART/series_host_ab.jsonl" \
     timeout 7200 python bench.py
   SNAP host_ab
   ALIVE
   HBBFT_TPU_NO_HOSTPIPE=1 HBBFT_TPU_NO_PIPELINE=1 \
     HBBFT_TPU_FQ_IMPL=rns BENCH_ONLY=array_n100 BENCH_ARRAY_BACKEND=tpu \
     BENCH_ARRAY_EPOCHS=3 BENCH_ARRAY_CHURN=0 \
+    BENCH_SERIES="$ART/series_host_ab_off.jsonl" \
     timeout 10800 python bench.py
   cp -f BENCH_rows.json "$ART/rows_after_host_ab_off.json" 2>/dev/null || true
   # side-by-side per-bucket host split (driver-readable in the log)
@@ -189,7 +195,8 @@ do_n64coin() {
   # >30 min into the 13:03 tunnel death (n64 coin macro is host-heavy on
   # this 1-core box); widen the timeout for the retry
   HBBFT_TPU_FQ_IMPL=rns BENCH_ONLY=array_n64_coin BENCH_COIN_MACRO_BACKEND=tpu \
-    BENCH_COIN_MACRO_EPOCHS=1 timeout 3600 python bench.py
+    BENCH_COIN_MACRO_EPOCHS=1 BENCH_SERIES="$ART/series_n64coin.jsonl" \
+    timeout 3600 python bench.py
 }
 do_rs_ab() {
   BENCH_ONLY=rs_encode timeout 900 python bench.py
@@ -299,6 +306,7 @@ do_n32_churn() {
   # hashing — itemized in PERF.md, native hash kernel is the next lever.
   HBBFT_TPU_FQ_IMPL=rns BENCH_ONLY=array_n100 BENCH_ARRAY_BACKEND=tpu \
     BENCH_ARRAY_N=32 BENCH_ARRAY_EPOCHS=3 BENCH_ARRAY_CHURN=1 \
+    BENCH_SERIES="$ART/series_n32_churn.jsonl" \
     timeout 5400 python bench.py
 }
 done_n16_churn() {
@@ -309,6 +317,7 @@ do_n16_churn() {
   # quick churn row: batched-DKG era change at the config-1 size
   HBBFT_TPU_FQ_IMPL=rns BENCH_ONLY=array_n100 BENCH_ARRAY_BACKEND=tpu \
     BENCH_ARRAY_N=16 BENCH_ARRAY_EPOCHS=3 BENCH_ARRAY_CHURN=1 \
+    BENCH_SERIES="$ART/series_n16_churn.jsonl" \
     timeout 3600 python bench.py
 }
 
@@ -323,6 +332,7 @@ do_n100_churn() {
   # so a dying window costs nothing already captured.
   HBBFT_TPU_FQ_IMPL=rns BENCH_ONLY=array_n100 BENCH_ARRAY_BACKEND=tpu \
     BENCH_ARRAY_EPOCHS=10 BENCH_ARRAY_CHURN=1 \
+    BENCH_SERIES="$ART/series_n100_churn.jsonl" \
     timeout 18000 python bench.py
 }
 

@@ -28,10 +28,27 @@ Two subcommands on one small CLI:
   gate SLO COMPLIANCE: a cell (the controller's ``adaptive`` cell
   above all) that held the declared SLO in OLD and lost it in NEW
   exits 1 whatever the ratios.
+* ``python tools/trace_report.py --critical-path PATH [PATH2]`` — print
+  the run-level gating histogram (fraction of epochs each phase gated,
+  obs/critpath.py) from any gating evidence: a per-epoch series
+  ``.jsonl`` (rows carry ``gate.phase``), a forensics bundle
+  (``critical_path.gating``), a BENCH capture whose rows carry a
+  ``gating`` field, or a raw Chrome trace (epoch windows re-gated from
+  the phase span categories).  With two paths, diff them: any phase
+  whose gating share shifted more than ``--tol`` absolute share points
+  (default 0.10) exits 1 — the commit-latency-attribution regression
+  gate.
+* ``python tools/trace_report.py --forensics BUNDLE...`` — validate
+  each flight-recorder forensics bundle (required keys, monotonic frame
+  epochs, gating shares sane, phase names inside the critpath registry)
+  and print its summary (reason, cell, gate one-liner, gating table,
+  fault kinds).  Exit 1 when any bundle is invalid.
 
 The validation helpers are imported by the test suite
 (tests/test_obs_tracer.py, tests/test_trace_smoke.py) — keep them
-dependency-free.
+dependency-free.  The critpath phase vocabulary below is a deliberate
+inline COPY of hbbft_tpu/obs/critpath.py (this tool must not import the
+package); tests/test_phase_labels.py pins the two against each other.
 """
 
 from __future__ import annotations
@@ -43,6 +60,34 @@ from typing import Any, Dict, List, Optional, Tuple
 
 #: keys every span event must carry (Chrome trace-event format)
 REQUIRED_KEYS = ("name", "ph", "ts", "pid", "tid")
+
+#: inline copy of hbbft_tpu/obs/critpath.py PHASES — the closed critpath
+#: phase vocabulary (tests/test_phase_labels.py pins the two lists)
+CRITPATH_PHASES = (
+    "rbc.output",
+    "ba.decide",
+    "coin.reveal",
+    "decrypt.combine",
+    "epoch.commit",
+    "crank",
+    "crash:recovery",
+)
+
+#: tracer span category -> critpath phase (inverse of critpath
+#: PHASE_SPAN_CATS; same guard test pins it) — how a raw Chrome trace's
+#: spans re-derive per-epoch gating without importing the package
+SPAN_CAT_PHASES = {
+    "rbc": "rbc.output",
+    "ba": "ba.decide",
+    "coin": "coin.reveal",
+    "decrypt": "decrypt.combine",
+    "epoch": "epoch.commit",
+    "crank": "crank",
+    "crash": "crash:recovery",
+}
+
+#: inline copy of hbbft_tpu/obs/flight.py REQUIRED_BUNDLE_KEYS
+REQUIRED_FORENSICS_KEYS = ("version", "kind", "reason", "frames", "critical_path")
 
 
 def load_events(path: str) -> List[Dict[str, Any]]:
@@ -516,6 +561,278 @@ def report_diff(old_path: str, new_path: str, tol: float) -> int:
     return 1 if regressed else 0
 
 
+# ---------------------------------------------------------------------------
+# critical-path gating (obs/critpath.py evidence, read dependency-free)
+# ---------------------------------------------------------------------------
+
+
+def _gating_from_gate_rows(rows: List[Dict[str, Any]]) -> Dict[str, float]:
+    """Gating histogram from per-epoch series rows (their ``gate.phase``
+    field, obs/timeseries.py) — one count per committed epoch."""
+    counts: Dict[str, int] = {}
+    for r in rows:
+        if not isinstance(r, dict):
+            continue
+        g = r.get("gate")
+        if isinstance(g, dict) and g.get("phase"):
+            counts[g["phase"]] = counts.get(g["phase"], 0) + 1
+    total = sum(counts[k] for k in sorted(counts))
+    if not total:
+        return {}
+    return {k: round(counts[k] / total, 4) for k in sorted(counts)}
+
+
+def gating_from_trace(events: List[Dict[str, Any]]) -> Dict[str, float]:
+    """Per-epoch gating re-derived from a raw Chrome trace: each
+    ``cat="epoch"`` span is an epoch window; the phase category (rbc /
+    ba / coin / decrypt / crash) with the largest summed duration inside
+    the window gates that epoch — the trace-side mirror of
+    ``critpath.path_from_phase_seconds``.  ``epoch``/``crank`` spans are
+    containers, not phases, so they never gate."""
+    spans: List[Dict[str, Any]] = []
+    stacks: Dict[Tuple[Any, Any], List[Dict[str, Any]]] = {}
+    for ev in events:
+        ph = ev.get("ph")
+        key = (ev.get("pid"), ev.get("tid"))
+        if ph == "B":
+            stacks.setdefault(key, []).append(ev)
+        elif ph == "E":
+            stack = stacks.get(key)
+            if stack:
+                b = stack.pop()
+                spans.append({"cat": b.get("cat", ""), "b": b["ts"], "e": ev["ts"]})
+    windows = sorted(
+        (s["b"], s["e"]) for s in spans if s["cat"] == "epoch"
+    )
+    counts: Dict[str, int] = {}
+    for wb, we in windows:
+        durs: Dict[str, float] = {}
+        for s in spans:
+            phase = SPAN_CAT_PHASES.get(s["cat"])
+            if phase is None or phase in ("epoch.commit", "crank"):
+                continue
+            if wb <= s["b"] <= we:
+                durs[phase] = durs.get(phase, 0.0) + (s["e"] - s["b"])
+        if not durs:
+            continue
+        gate, best = "epoch.commit", -1.0
+        for phase in sorted(durs):
+            if durs[phase] > best:
+                best = durs[phase]
+                gate = phase
+        counts[gate] = counts.get(gate, 0) + 1
+    total = sum(counts[k] for k in sorted(counts))
+    if not total:
+        return {}
+    return {k: round(counts[k] / total, 4) for k in sorted(counts)}
+
+
+def load_gating(path: str) -> Dict[str, float]:
+    """The gating histogram from whichever evidence ``path`` holds:
+    a per-epoch series ``.jsonl`` (rows carry ``gate``), a forensics
+    bundle (``critical_path.gating``), a BENCH/soak capture whose rows
+    carry a ``gating`` field (averaged across rows), or a raw Chrome
+    trace (re-gated from span categories)."""
+    if path.endswith(".jsonl"):
+        with open(path) as f:
+            rows = [json.loads(line) for line in f if line.strip()]
+        return _gating_from_gate_rows(rows)
+    with open(path) as f:
+        doc = json.load(f)
+    rows: Any = None
+    if isinstance(doc, dict):
+        if doc.get("kind") == "forensics":
+            cp = doc.get("critical_path") or {}
+            return dict(cp.get("gating") or {})
+        if "traceEvents" in doc:
+            return gating_from_trace(doc["traceEvents"])
+        rows = doc.get("rows")
+    elif isinstance(doc, list):
+        rows = doc
+    if isinstance(rows, list):
+        g = _gating_from_gate_rows(rows)
+        if g:
+            return g
+        per_row = [
+            r["gating"]
+            for r in rows
+            if isinstance(r, dict) and isinstance(r.get("gating"), dict) and r["gating"]
+        ]
+        if per_row:
+            acc: Dict[str, float] = {}
+            for g in per_row:
+                for phase in sorted(g):
+                    acc[phase] = acc.get(phase, 0.0) + g[phase]
+            return {phase: round(acc[phase] / len(per_row), 4) for phase in sorted(acc)}
+    raise ValueError(
+        f"{path}: no gating evidence (expected series .jsonl, forensics "
+        "bundle, rows with a 'gating' field, or a Chrome trace)"
+    )
+
+
+def gating_shifts(
+    old: Dict[str, float], new: Dict[str, float], tol: float = 0.10
+) -> List[Dict[str, Any]]:
+    """Phase-share shifts beyond ``tol`` ABSOLUTE share points between
+    two gating histograms (inline twin of ``critpath.diff_gating``) —
+    'coin went from gating 20% of epochs to 45%' is a >tol shift even
+    though both captures pass every throughput gate."""
+    out: List[Dict[str, Any]] = []
+    for phase in sorted(set(old) | set(new)):
+        a, b = old.get(phase, 0.0), new.get(phase, 0.0)
+        if abs(b - a) > tol:
+            out.append(
+                {
+                    "phase": phase,
+                    "old": round(a, 4),
+                    "new": round(b, 4),
+                    "shift": round(b - a, 4),
+                }
+            )
+    return out
+
+
+def report_critical_path(paths: List[str], tol: float) -> int:
+    if len(paths) == 1:
+        gating = load_gating(paths[0])
+        if not gating:
+            print(f"{paths[0]}: no gated epochs")
+            return 0
+        print(f"{'gating phase':>18} {'share':>7}")
+        for phase in sorted(gating, key=lambda p: (-gating[p], p)):
+            print(f"{phase:>18} {gating[phase]:>6.1%}")
+        return 0
+    old, new = load_gating(paths[0]), load_gating(paths[1])
+    shifts = gating_shifts(old, new, tol)
+    shifted = {s["phase"] for s in shifts}
+    print(f"{'gating phase':>18} {'old':>7} {'new':>7} {'shift':>8}")
+    for phase in sorted(set(old) | set(new)):
+        a, b = old.get(phase, 0.0), new.get(phase, 0.0)
+        flag = "  SHIFT" if phase in shifted else ""
+        print(f"{phase:>18} {a:>6.1%} {b:>6.1%} {b - a:>+7.1%}{flag}")
+    print(
+        f"{len(shifts)} gating shift(s) beyond {tol:.0%} share points "
+        f"across {len(set(old) | set(new))} phases"
+    )
+    return 1 if shifts else 0
+
+
+# ---------------------------------------------------------------------------
+# forensics bundles (obs/flight.py dumps, validated dependency-free)
+# ---------------------------------------------------------------------------
+
+
+def validate_forensics(doc: Any) -> List[str]:
+    """Structural checks on a flight-recorder bundle (inline twin of
+    ``obs/flight.validate_bundle``): required keys, version/kind,
+    monotonic frame epochs, gating shares in range and summing to 1,
+    every phase name inside the critpath registry."""
+    errors: List[str] = []
+    if not isinstance(doc, dict):
+        return ["bundle is not a JSON object"]
+    for k in REQUIRED_FORENSICS_KEYS:
+        if k not in doc:
+            errors.append(f"missing key {k!r}")
+    if errors:
+        return errors
+    if doc["version"] != 1:
+        errors.append(f"unknown version {doc['version']!r}")
+    if doc["kind"] != "forensics":
+        errors.append(f"kind is {doc['kind']!r}, not 'forensics'")
+    frames = doc["frames"]
+    if not isinstance(frames, list) or not frames:
+        errors.append("frames must be a non-empty list")
+        return errors
+    prev = None
+    for i, fr in enumerate(frames):
+        ep = fr.get("epoch") if isinstance(fr, dict) else None
+        if not isinstance(ep, int):
+            errors.append(f"frame {i} has no integer epoch")
+            continue
+        if prev is not None and ep < prev:
+            errors.append(f"frame epochs not monotonic at index {i} ({ep} < {prev})")
+        prev = ep
+    cp = doc["critical_path"]
+    if not isinstance(cp, dict) or "gating" not in cp or "paths" not in cp:
+        errors.append("critical_path must hold 'gating' and 'paths'")
+        return errors
+    share_sum = 0.0
+    for phase in sorted(cp["gating"]):
+        share = cp["gating"][phase]
+        if phase not in CRITPATH_PHASES:
+            errors.append(f"gating phase {phase!r} not in the critpath registry")
+        if not 0.0 <= share <= 1.0001:
+            errors.append(f"gating share out of range for {phase!r}: {share}")
+        share_sum += share
+    if cp["gating"] and not 0.99 <= share_sum <= 1.01:
+        errors.append(f"gating shares sum to {share_sum:.4f}, not 1")
+    for j, p in enumerate(cp["paths"]):
+        if p.get("gate_phase") not in CRITPATH_PHASES:
+            errors.append(f"path {j} gate_phase {p.get('gate_phase')!r} unknown")
+    return errors
+
+
+def summarize_forensics(doc: Dict[str, Any]) -> List[str]:
+    """Human summary lines for a valid bundle (mirrors
+    ``obs/flight.summarize_bundle``)."""
+    frames = doc.get("frames", [])
+    epochs = [fr.get("epoch") for fr in frames if isinstance(fr.get("epoch"), int)]
+    span = f"epochs {min(epochs)}..{max(epochs)}" if epochs else "no epochs"
+    lines = [
+        f"forensics: reason={doc.get('reason')!r} frames={len(frames)} ({span})",
+    ]
+    ctx = doc.get("context") or {}
+    cell = ctx.get("cell") if isinstance(ctx, dict) else None
+    if isinstance(cell, dict):
+        axes = "x".join(
+            str(cell.get(k))
+            for k in ("attack", "schedule", "churn", "crash", "traffic")
+        )
+        lines.append(f"  cell: {axes} n={cell.get('n')} seed={cell.get('seed')}")
+    cp = doc.get("critical_path") or {}
+    if cp.get("gate"):
+        lines.append(f"  gate: {cp['gate']}")
+    gating = cp.get("gating") or {}
+    for phase in sorted(gating, key=lambda p: (-gating[p], p)):
+        lines.append(f"  gating {phase}: {gating[phase] * 100:.1f}%")
+    why = doc.get("why") or {}
+    summary = why.get("summary") if isinstance(why, dict) else None
+    if summary:
+        lines.append(f"  why: {summary[0]}")
+    faults = doc.get("faults") or []
+    kinds: Dict[str, int] = {}
+    for t in faults:
+        kind = t[2] if isinstance(t, (list, tuple)) and len(t) == 3 else repr(t)
+        kinds[kind] = kinds.get(kind, 0) + 1
+    for kind in sorted(kinds):
+        lines.append(f"  fault {kind}: {kinds[kind]}")
+    return lines
+
+
+def report_forensics(paths: List[str]) -> int:
+    rc = 0
+    for path in paths:
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"{path}: UNREADABLE ({e})")
+            rc = 1
+            continue
+        errors = validate_forensics(doc)
+        if errors:
+            print(f"{path}: INVALID ({len(errors)} error(s))")
+            for e in errors[:20]:
+                print("  " + e)
+            rc = 1
+            continue
+        lines = summarize_forensics(doc)
+        print(f"{path}: valid")
+        for line in lines:
+            print("  " + line)
+    return rc
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     p.add_argument("paths", nargs="+", help="TRACE, or OLD NEW with --diff")
@@ -534,6 +851,18 @@ def main(argv=None) -> int:
         help="diff qhb_traffic throughput/latency curves cell by cell "
         "between two BENCH_rows.json files; a >tol tx/s drop or >tol "
         "p99 commit-latency rise exits 1",
+    )
+    p.add_argument(
+        "--critical-path", action="store_true",
+        help="print the run-level gating histogram from gating evidence "
+        "(series .jsonl / forensics bundle / rows with 'gating' / Chrome "
+        "trace); with two paths, diff them — a phase share shifting more "
+        "than --tol absolute points exits 1",
+    )
+    p.add_argument(
+        "--forensics", action="store_true",
+        help="validate each flight-recorder forensics bundle and print "
+        "its summary; exit 1 when any bundle is invalid",
     )
     p.add_argument(
         "--tol", type=float, default=0.10,
@@ -563,6 +892,12 @@ def main(argv=None) -> int:
         "(default 0.10)",
     )
     args = p.parse_args(argv)
+    if args.forensics:
+        return report_forensics(args.paths)
+    if args.critical_path:
+        if len(args.paths) not in (1, 2):
+            p.error("--critical-path takes one path (report) or two (diff)")
+        return report_critical_path(args.paths, args.tol)
     if args.traffic:
         if len(args.paths) != 2:
             p.error("--traffic needs exactly two BENCH_rows.json paths")
