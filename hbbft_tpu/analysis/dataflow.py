@@ -402,6 +402,12 @@ def self_contains(outer: ast.AST, inner: ast.AST) -> bool:
 
 
 def summarize_module(mod: ModuleSource) -> ModuleSummary:
+    # Memoized on the (immutable) ModuleSource: seam-race and the three
+    # snapshot rules all summarize overlapping scopes in one lint run,
+    # and the walk dominates lint wall time.
+    cached = getattr(mod, "_dataflow_summary", None)
+    if cached is not None:
+        return cached
     out = ModuleSummary(path=mod.path)
     for node in mod.tree.body:
         if isinstance(node, ast.ClassDef):
@@ -423,6 +429,10 @@ def summarize_module(mod: ModuleSource) -> ModuleSummary:
             out.classes[node.name] = cls
         elif isinstance(node, ast.FunctionDef):
             out.functions[node.name] = summarize_function(node, node.name)
+    try:
+        mod._dataflow_summary = out  # type: ignore[attr-defined]
+    except AttributeError:
+        pass  # slotted test double: caching is best-effort
     return out
 
 

@@ -76,6 +76,8 @@ class ModuleSource:
     def _scan_suppressions(self) -> None:
         # Real COMMENT tokens only (tokenize): allow-syntax quoted inside a
         # docstring or string literal must not create phantom suppressions.
+        if "allow[" not in self.text:
+            return  # fast path: tokenizing dominates project load time
         try:
             tokens = list(tokenize.generate_tokens(io.StringIO(self.text).readline))
         except (tokenize.TokenError, IndentationError, SyntaxError):
@@ -194,6 +196,7 @@ def all_rules() -> List[Rule]:
         rules_determinism,
         rules_exhaustiveness,
         rules_seam,
+        rules_snapshot,
         rules_tracer,
     )
 

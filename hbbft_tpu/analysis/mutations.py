@@ -161,3 +161,78 @@ def target_runner(name: str):
 
 
 MUTANT_NAMES = ("accum", "counter", "listener")
+
+
+# ---------------------------------------------------------------------------
+# Snapshot/WAL-replay mutants (PR 17)
+# ---------------------------------------------------------------------------
+#
+# The same sensitivity doctrine for the snapshot rule family
+# (analysis/rules_snapshot.py): three deliberately broken state classes,
+# each the minimal shape of a durability bug the rules exist to catch.
+# tests/test_lint.py lints this module's source *as if it lived at a
+# ``_STATE_MODULES`` path* (hbbft_tpu/net/crash.py) and pins one finding
+# per mutant.  Nothing imports these classes at runtime.
+
+
+class UndeclaredCallableStateNode:
+    """Snapshot mutant ``coverage``: a runtime write stores a callable in
+    an attribute that is not declared in ``_SNAPSHOT_ENV_ATTRS`` — the
+    first ``save_node`` after this write dies with ``SnapshotError:
+    callable in state``.  (``tracer`` is declared, ``_notify`` is the
+    drift.)"""
+
+    tracer = None
+    _SNAPSHOT_ENV_ATTRS = ("tracer",)
+
+    def __init__(self) -> None:
+        self.seen = 0
+
+    def on_deliver(self, sender: Any, payload: Any) -> None:
+        self.seen += 1
+        self._notify = lambda: payload  # BUG: callable state, undeclared
+
+
+class ReplayHookNode:
+    """Snapshot mutant ``replay-hook``: the WAL replay loop invokes a
+    checkpoint-detached hook.  On a restored node ``batch_listeners`` is
+    the class default ``()`` while the pre-crash instance had live
+    listeners — replay diverges (or silently skips effects) depending on
+    environment attachment."""
+
+    batch_listeners = ()
+    _SNAPSHOT_ENV_ATTRS = ("batch_listeners",)
+
+    def __init__(self) -> None:
+        self.log: List[Any] = []
+
+    def _replay(self, wal: Sequence[Any]) -> None:
+        for entry in wal:
+            self._apply(entry)
+
+    def _apply(self, entry: Any) -> None:
+        self.log.append(entry)
+        for cb in self.batch_listeners:
+            cb(entry)  # BUG: detached hook steered by WAL replay
+
+
+class ReplayEnvReadNode:
+    """Snapshot mutant ``replay-read``: the replay path reads a
+    checkpoint-detached env attr without a guard.  The live instance
+    carries a metrics sink; the restored instance replays with the class
+    default ``None`` — ``AttributeError`` at best, divergent state at
+    worst."""
+
+    metrics_log = None
+    _SNAPSHOT_ENV_ATTRS = ("metrics_log",)
+
+    def __init__(self) -> None:
+        self.rows: List[Any] = []
+
+    def _restart(self, wal: Sequence[Any]) -> None:
+        for entry in wal:
+            # BUG: unguarded env read on the replay path
+            self.rows.append((entry, self.metrics_log))
+
+
+SNAPSHOT_MUTANT_NAMES = ("coverage", "replay-hook", "replay-read")

@@ -180,6 +180,9 @@ class SeamRaceRule(Rule):
         "hbbft_tpu/ops/backend.py",
         "hbbft_tpu/engine/",
         "hbbft_tpu/net/crash.py",
+        # the mesh backend seam (ROADMAP item 1): cross-shard submit /
+        # resolve ordering must hold before the pjit scale-out lands
+        "hbbft_tpu/parallel/",
         # the control loop's hook crossing (PR 12): the traffic drivers'
         # admission/sampling methods call mempool ``submit`` (submit-
         # seeded), and any future deferred/resolver context added to the

@@ -581,6 +581,10 @@ class CrashManager:
         parked_inputs, t.parked_inputs = t.parked_inputs, []
         for inp in parked_inputs:
             net.send_input(nid, inp)
+        # lint: allow[replay-purity] post-replay env reattachment: the WAL
+        # loop above has already sealed replayed state; listeners exist
+        # precisely so the environment (traffic driver, controller) can
+        # re-install its checkpoint-detached hooks on the fresh instance
         for fn in self.restart_listeners:
             try:
                 fn(net, nid, node.algorithm)

@@ -893,7 +893,11 @@ def _soak_collect(result: SoakResult, net, driver) -> None:
 
 
 def run_cell(
-    cell: Cell, backend=None, crank_limit: int = 5_000_000, obs: bool = True
+    cell: Cell,
+    backend=None,
+    crank_limit: int = 5_000_000,
+    obs: bool = True,
+    net_sink: Optional[List] = None,
 ) -> SoakResult:
     """Run one composed-gauntlet cell; never raises — a starved cell
     comes back ok=False with the why-stalled report naming the dominant
@@ -907,12 +911,17 @@ def run_cell(
     bundle lands on ``result.forensics`` when the cell dies (CrankError,
     verdict failure, or a ``crash:*`` fault).  None of it enters the
     replay fingerprint.  Cells run sequentially, so the single
-    process-wide stamp hook is activated around this run only."""
+    process-wide stamp hook is activated around this run only.
+
+    ``net_sink`` (a caller-supplied list) receives the live VirtualNet
+    before the first crank — the post-run inspection hook the dynamic
+    snapshot-coverage twin test uses to diff restored instances against
+    live ones."""
     rec = _critpath.CritPathRecorder() if obs else None
     if rec is not None:
         _critpath.activate(rec)
     try:
-        return _run_cell(cell, backend, crank_limit, rec)
+        return _run_cell(cell, backend, crank_limit, rec, net_sink)
     finally:
         if rec is not None:
             _critpath.deactivate()
@@ -923,6 +932,7 @@ def _run_cell(
     backend,
     crank_limit: int,
     rec: Optional[_critpath.CritPathRecorder],
+    net_sink: Optional[List] = None,
 ) -> SoakResult:
     from hbbft_tpu.protocols.change import Change
     from hbbft_tpu.protocols.honey_badger import EncryptionSchedule
@@ -935,6 +945,8 @@ def _run_cell(
     traffic = TRAFFICS[cell.traffic]
     result = SoakResult(cell=cell)
     net = build_cell_net(cell, backend=backend, crank_limit=crank_limit)
+    if net_sink is not None:
+        net_sink.append(net)
     f = cell.f if cell.f is not None else (cell.n - 1) // 3
 
     driver = None

@@ -127,6 +127,10 @@ class NetSchedule:
         self.latency = latency
         self.jitter = jitter
         self.drop = drop
+        # lint: allow[hook-detachment] shape callables in snapshotted runs
+        # are module-level functions (the encoder serializes them by name);
+        # env-dropping link_latency would silently flatten WAN latencies on
+        # restore, breaking replay determinism the other way
         self.link_latency = link_latency
         self.partitions = tuple(partitions)
         self.partition_mode = partition_mode
@@ -208,12 +212,21 @@ class VirtualNet:
     #: whole-net snapshots drop them and restore falls back to None.
     critpath = None
     metrics_log = None
+    #: structured per-crank event sink and span tracer (hbbft_tpu/obs) —
+    #: environment, not state: both are observer planes holding open-ended
+    #: buffers (and the tracer holds live hook callables), so a whole-net
+    #: snapshot taken with either attached must drop them rather than die
+    #: in the encoder; restore falls back to None (headless net).
+    event_log = None
+    tracer = None
     _SNAPSHOT_ENV_ATTRS = (
         "traffic",
         "crank_chooser",
         "race_probe",
         "critpath",
         "metrics_log",
+        "event_log",
+        "tracer",
     )
     #: class fallback so pre-crash-axis whole-net snapshots restore
     #: (decode sets only serialized attrs); instances always assign it
@@ -492,6 +505,10 @@ class VirtualNet:
             self.counters.faults_recorded += len(step.fault_log.entries)
             if self.event_log is not None:
                 for f in step.fault_log.entries:
+                    # lint: allow[replay-purity] observer plane: emit is
+                    # guarded and records evidence only — events never
+                    # feed protocol state, and a restored net replays
+                    # headless (event_log falls back to None)
                     self.event_log.emit(
                         event="fault", observer=node.id, node=f.node_id, kind=f.kind
                     )
@@ -527,6 +544,9 @@ class VirtualNet:
             return  # recipient is down: parked until its restart
         if self.race_probe is not None:
             # stable content key + causal edge to the enqueuing crank
+            # lint: allow[replay-purity] explorer probe: tags carry
+            # observer-only metadata (never read by protocol code), and a
+            # restored net replays unprobed (race_probe falls back to None)
             self.race_probe.tag_message(msg)
         if self.schedule is not None:
             delay = self.schedule.on_send(self, msg)
@@ -675,6 +695,8 @@ class NetBuilder:
         node.  Constructors that accept a third argument receive the net's
         seeded rng (needed by protocols that generate key material, e.g.
         DynamicHoneyBadger's in-band DKG)."""
+        # lint: allow[hook-detachment] the builder is pre-run configuration:
+        # no live net references it, so it can never appear in a snapshot
         self._constructor = constructor
         return self
 

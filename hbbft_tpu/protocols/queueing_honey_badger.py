@@ -192,9 +192,18 @@ class QueueingHoneyBadger(ConsensusProtocol):
         b = (
             self.batch_size
             if self.batch_size_provider is None
+            # lint: allow[replay-purity] detached during replay by
+            # construction: restore drops the provider and the restart
+            # listener reattaches it only after the WAL loop finishes, so
+            # replayed proposals fall back to the logged ("batch_size", B)
+            # input channel — the replay-safe path for B
             else int(self.batch_size_provider())
         )
         sample = self.queue.choose(self.rng, b)
         if self.sample_listener is not None:
+            # lint: allow[replay-purity] observer-only: the listener sees a
+            # copy of the sample and its return value is ignored; a restored
+            # node replays unsampled (listener falls back to None) and the
+            # driver reattaches it post-replay via restart_listeners
             self.sample_listener(sample)
         return self._wrap(self.dhb.propose(sample, self.rng))

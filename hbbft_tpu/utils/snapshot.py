@@ -297,6 +297,9 @@ class _Decoder:
             return self.backend.group
         if tag == "rng":
             _, idx, version, state, gauss = node
+            # lint: allow[replay-purity] not an entropy draw: the fresh
+            # Random is a shell whose state is overwritten on the next
+            # line by the checkpoint-logged (version, state, gauss) tuple
             r = random.Random()
             r.setstate((version, tuple(state), self.decode(gauss)))
             self.objects[idx] = r

@@ -679,6 +679,9 @@ def test_all_rules_registered():
         "deferred-fetch",
         "glv-table-order",
         "seam-race",
+        "snapshot-coverage",
+        "replay-purity",
+        "hook-detachment",
     }
 
 
@@ -1751,3 +1754,466 @@ def test_seam_race_covers_control_and_traffic_driver():
     )
     assert len(findings) == 1
     assert "self.pending" in findings[0].message
+
+
+# ---------------------------------------------------------------------------
+# Rule family 8: snapshot-coverage / replay-purity / hook-detachment (PR 17)
+# ---------------------------------------------------------------------------
+
+from hbbft_tpu.analysis.rules_snapshot import (  # noqa: E402
+    HookDetachmentRule,
+    ReplayPurityRule,
+    SnapshotCoverageRule,
+    replay_reach_for_testing,
+)
+from hbbft_tpu.analysis.stateinv import state_module_paths  # noqa: E402
+
+#: real _STATE_MODULES paths — synthetic sources are mapped here so the
+#: rules (whose scope is the registry, parsed from utils/snapshot.py on
+#: disk) pick them up
+STATE_PATH = "hbbft_tpu/net/crash.py"
+STATE_PATH2 = "hbbft_tpu/protocols/queueing_honey_badger.py"
+
+
+def test_state_module_paths_resolve_from_disk():
+    """Unit tests lint synthetic module sets: the registry still resolves
+    (from the repo's utils/snapshot.py) so scoping works."""
+    project = LintProject(REPO_ROOT, {})
+    paths = state_module_paths(project)
+    assert STATE_PATH in paths
+    assert STATE_PATH2 in paths
+    assert "hbbft_tpu/net/virtual_net.py" in paths
+    assert all(p.endswith(".py") for p in paths)
+
+
+def test_snapshot_coverage_catches_runtime_callable_write():
+    findings = lint_sources(
+        SnapshotCoverageRule(),
+        {
+            STATE_PATH: """\
+            class Node:
+                def __init__(self):
+                    self.seen = 0
+
+                def on_deliver(self, payload):
+                    self.notify = lambda: payload
+            """
+        },
+    )
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.rule == "snapshot-coverage"
+    assert "self.notify" in f.message and "lambda" in f.message
+    assert "save_node rejects callables" in f.message
+
+
+def test_snapshot_coverage_env_declared_callable_is_clean():
+    findings = lint_sources(
+        SnapshotCoverageRule(),
+        {
+            STATE_PATH: """\
+            class Node:
+                notify = None
+                _SNAPSHOT_ENV_ATTRS = ("notify",)
+
+                def on_deliver(self, payload):
+                    self.notify = lambda: payload
+            """
+        },
+    )
+    assert findings == []
+
+
+def test_snapshot_coverage_flags_dead_env_declaration():
+    findings = lint_sources(
+        SnapshotCoverageRule(),
+        {
+            STATE_PATH: """\
+            class Node:
+                tracer = None
+                _SNAPSHOT_ENV_ATTRS = ("tracer", "ghost")
+
+                def crank(self):
+                    if self.tracer is not None:
+                        self.tracer.span("x")
+            """
+        },
+    )
+    assert len(findings) == 1
+    assert "ghost" in findings[0].message
+    assert "dead declaration" in findings[0].message
+
+
+def test_snapshot_coverage_flags_env_attr_without_class_default():
+    findings = lint_sources(
+        SnapshotCoverageRule(),
+        {
+            STATE_PATH: """\
+            class Node:
+                _SNAPSHOT_ENV_ATTRS = ("tracer",)
+
+                def __init__(self, tracer):
+                    self.tracer = tracer
+            """
+        },
+    )
+    assert len(findings) == 1
+    assert "no class-body default" in findings[0].message
+    assert "AttributeError" in findings[0].message
+
+
+def test_snapshot_coverage_suppression_honoured():
+    findings = lint_sources(
+        SnapshotCoverageRule(),
+        {
+            STATE_PATH: """\
+            class Node:
+                def on_deliver(self, payload):
+                    # lint: allow[snapshot-coverage] fixture: justified
+                    self.notify = lambda: payload
+            """
+        },
+    )
+    assert findings == []
+
+
+def test_replay_purity_hook_invocation_flagged_with_chain():
+    findings = lint_sources(
+        ReplayPurityRule(),
+        {
+            STATE_PATH: """\
+            class Mgr:
+                listeners = ()
+                _SNAPSHOT_ENV_ATTRS = ("listeners",)
+
+                def _restart(self, wal):
+                    for e in wal:
+                        self._apply(e)
+
+                def _apply(self, e):
+                    for fn in self.listeners:
+                        fn(e)
+            """
+        },
+    )
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.rule == "replay-purity"
+    assert "invokes checkpoint-detached hook self.listeners" in f.message
+    assert "Mgr._restart" in f.message and "Mgr._apply" in f.message
+
+
+def test_replay_purity_guarded_env_read_is_clean_unguarded_flagged():
+    src = """\
+    class Mgr:
+        sink = None
+        log = None
+        _SNAPSHOT_ENV_ATTRS = ("sink", "log")
+
+        def _restart(self, wal):
+            if self.sink is not None:
+                size = self.sink
+            rows = [self.log]
+    """
+    findings = lint_sources(ReplayPurityRule(), {STATE_PATH: src})
+    assert len(findings) == 1
+    assert "self.log" in findings[0].message
+    assert "read of checkpoint-detached env attr" in findings[0].message
+
+
+def test_replay_purity_entropy_and_wallclock_flagged():
+    findings = lint_sources(
+        ReplayPurityRule(),
+        {
+            STATE_PATH: """\
+            import random
+            import time
+
+            class Mgr:
+                def _replay(self, wal):
+                    jitter = random.random()
+                    now = time.monotonic()
+            """
+        },
+    )
+    msgs = sorted(f.message for f in findings)
+    assert len(findings) == 2
+    assert "entropy outside the logged rng stream: random.random()" in msgs[0]
+    assert "wall-clock read: time.monotonic()" in msgs[1]
+
+
+def test_replay_purity_propagates_across_modules_by_name():
+    """The seed in net/crash.py reaches handler methods in other modules
+    (caller→callee by name, like seam-race's tag propagation)."""
+    sources = {
+        STATE_PATH: """\
+        class Mgr:
+            def _restart(self, net, node):
+                node.algorithm.handle_message(None, ("m",))
+        """,
+        STATE_PATH2: """\
+        class Proto:
+            sample_listener = None
+            _SNAPSHOT_ENV_ATTRS = ("sample_listener",)
+
+            def handle_message(self, sender, msg):
+                self.sample_listener(msg)
+        """,
+    }
+    findings = lint_sources(ReplayPurityRule(), sources)
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.path == STATE_PATH2
+    assert "self.sample_listener" in f.message
+    assert "Mgr._restart" in f.message  # chain names the seed
+    modules = {
+        p: ModuleSource(p, textwrap.dedent(s)) for p, s in sources.items()
+    }
+    reach = replay_reach_for_testing(LintProject(REPO_ROOT, modules))
+    assert f"{STATE_PATH2}:Proto.handle_message" in reach
+
+
+def test_replay_purity_only_seeds_in_crash_module():
+    """``_replay_term`` in binary_agreement (a protocol-internal cache
+    replay) must NOT seed: seeds live in net/crash.py only."""
+    findings = lint_sources(
+        ReplayPurityRule(),
+        {
+            "hbbft_tpu/protocols/binary_agreement.py": """\
+            class BA:
+                probe = None
+                _SNAPSHOT_ENV_ATTRS = ("probe",)
+
+                def _replay_term(self, b):
+                    self.probe(b)
+            """
+        },
+    )
+    assert findings == []
+
+
+def test_replay_purity_suppression_honoured():
+    findings = lint_sources(
+        ReplayPurityRule(),
+        {
+            STATE_PATH: """\
+            class Mgr:
+                listeners = ()
+                _SNAPSHOT_ENV_ATTRS = ("listeners",)
+
+                def _restart(self, wal):
+                    # lint: allow[replay-purity] fixture: justified
+                    for fn in self.listeners:
+                        fn(wal)
+            """
+        },
+    )
+    assert findings == []
+
+
+def test_hook_detachment_flags_param_assigned_invoked_attr():
+    findings = lint_sources(
+        HookDetachmentRule(),
+        {
+            STATE_PATH: """\
+            class Node:
+                def __init__(self, on_commit):
+                    self.on_commit = on_commit
+
+                def commit(self, batch):
+                    self.on_commit(batch)
+            """
+        },
+    )
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.rule == "hook-detachment"
+    assert "self.on_commit" in f.message
+    assert "parameter on_commit" in f.message
+
+
+def test_hook_detachment_env_declared_or_uncalled_is_clean():
+    findings = lint_sources(
+        HookDetachmentRule(),
+        {
+            STATE_PATH: """\
+            class Node:
+                on_commit = None
+                _SNAPSHOT_ENV_ATTRS = ("on_commit",)
+
+                def __init__(self, on_commit, doc):
+                    self.on_commit = on_commit
+                    self.doc = doc  # param-assigned but never invoked
+
+                def commit(self, batch):
+                    if self.on_commit is not None:
+                        self.on_commit(batch)
+            """
+        },
+    )
+    assert findings == []
+
+
+def test_hook_detachment_suppression_honoured():
+    findings = lint_sources(
+        HookDetachmentRule(),
+        {
+            STATE_PATH: """\
+            class Node:
+                def __init__(self, on_commit):
+                    # lint: allow[hook-detachment] fixture: justified
+                    self.on_commit = on_commit
+
+                def commit(self, batch):
+                    self.on_commit(batch)
+            """
+        },
+    )
+    assert findings == []
+
+
+# -- the three seeded snapshot mutants (analysis/mutations.py) -------------
+
+
+def _mutations_source():
+    return (REPO_ROOT / "hbbft_tpu" / "analysis" / "mutations.py").read_text(
+        encoding="utf-8"
+    )
+
+
+def test_snapshot_mutant_coverage_caught_minimal():
+    """Mutant 1: the undeclared runtime callable is caught with exactly
+    one finding naming the attr, the class, and the writing method."""
+    findings = lint_sources(
+        SnapshotCoverageRule(), {STATE_PATH: _mutations_source()}
+    )
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.rule == "snapshot-coverage"
+    assert "self._notify" in f.message
+    assert "UndeclaredCallableStateNode" in f.message
+    assert "on_deliver" in f.message
+    assert "lambda" in f.message
+
+
+def test_snapshot_mutant_replay_hook_and_read_caught_minimal():
+    """Mutants 2+3: the replay-path hook invocation and the unguarded
+    env read are each caught with exactly one finding, chains intact."""
+    findings = lint_sources(
+        ReplayPurityRule(), {STATE_PATH: _mutations_source()}
+    )
+    assert len(findings) == 2
+    hook = [f for f in findings if "batch_listeners" in f.message]
+    read = [f for f in findings if "metrics_log" in f.message]
+    assert len(hook) == 1 and len(read) == 1
+    assert "invokes checkpoint-detached hook" in hook[0].message
+    assert "ReplayHookNode._replay" in hook[0].message  # chain to seed
+    assert "read of checkpoint-detached env attr" in read[0].message
+    assert "ReplayEnvReadNode._restart" in read[0].message
+
+
+def test_snapshot_mutants_out_of_scope_at_real_path():
+    """At its real path (hbbft_tpu/analysis/) the mutants module is out
+    of every snapshot-rule scope: the package gate stays clean."""
+    src = _mutations_source()
+    real = "hbbft_tpu/analysis/mutations.py"
+    for rule in (SnapshotCoverageRule(), ReplayPurityRule(), HookDetachmentRule()):
+        assert lint_sources(rule, {real: src}) == []
+
+
+# -- stale-suppression coverage for the new families (satellite 6) ---------
+
+
+def test_stale_suppression_covers_snapshot_family(tmp_path):
+    """A dead allow[snapshot-coverage] / allow[replay-purity] is flagged
+    stale; a live one is not."""
+    _write_module(
+        tmp_path,
+        "hbbft_tpu/utils/snapshot.py",
+        """\
+        _STATE_MODULES = ("hbbft_tpu.protocols.x",)
+        """,
+    )
+    p = _write_module(
+        tmp_path,
+        "hbbft_tpu/protocols/x.py",
+        """\
+        class Node:
+            def on_deliver(self, payload):
+                # lint: allow[snapshot-coverage] fixture: justified live
+                self.notify = lambda: payload
+                x = 1  # lint: allow[replay-purity] fixture: dead allow
+        """,
+    )
+    reg = tmp_path / "hbbft_tpu" / "utils" / "snapshot.py"
+    findings = run_lint(tmp_path, [p, reg])
+    assert [f.rule for f in findings] == ["stale-suppression"]
+    assert "allow[replay-purity]" in findings[0].message
+
+
+# -- seam-race scope: the mesh backend seam (satellite 1) ------------------
+
+
+def test_seam_race_covers_parallel():
+    assert "hbbft_tpu/parallel/" in SeamRaceRule.scope
+    findings = lint_sources(
+        SeamRaceRule(),
+        {
+            "hbbft_tpu/parallel/_seeded.py": """\
+            class MeshBackend:
+                def __init__(self):
+                    self.pending = []
+
+                def _submit_shard(self, pipe, items):
+                    self.pending.append(items)
+                    pipe.submit(items)
+
+                def _resolve_shard(self, res):
+                    return list(self.pending)
+            """
+        },
+    )
+    assert len(findings) == 1
+    assert "self.pending" in findings[0].message
+
+
+# -- tools/lint.py --json (satellite 2) ------------------------------------
+
+
+def test_lint_json_output_schema_pinned(tmp_path):
+    import json as _json
+    import subprocess as _sp
+    import sys as _sys
+
+    out = tmp_path / "findings.json"
+    proc = _sp.run(
+        [_sys.executable, "tools/lint.py", "--json", str(out)],
+        cwd=REPO_ROOT,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    doc = _json.loads(out.read_text(encoding="utf-8"))
+    assert doc["schema"] == "hbbft-tpu-lint/1"
+    assert doc["new"] == []  # the tree is clean: the gate pins this
+    assert isinstance(doc["grandfathered"], int)
+    # the human summary stays on stdout when --json targets a file
+    assert "lint: 0 new finding(s)" in proc.stdout
+
+
+def test_lint_json_stable_sort_and_stdout_mode(tmp_path):
+    """--json - puts the document on stdout (summary to stderr) and the
+    findings list rides Finding.sort_key order."""
+    import json as _json
+
+    from tools.lint import findings_document
+
+    f1 = Finding("replay-purity", "b.py", 9, 0, "zzz")
+    f2 = Finding("snapshot-coverage", "a.py", 2, 1, "aaa")
+    f3 = Finding("snapshot-coverage", "a.py", 1, 5, "mmm")
+    doc = findings_document([f1, f2, f3], grandfathered=0)
+    assert [(e["path"], e["line"]) for e in doc["new"]] == [
+        ("a.py", 1), ("a.py", 2), ("b.py", 9)
+    ]
+    _json.dumps(doc)  # serializable

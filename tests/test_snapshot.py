@@ -367,3 +367,86 @@ def test_rng_identity_shared_between_net_and_protocols():
     assert net.nodes[0].algorithm.rng is net.rng
     net2 = load_node(save_node(net), MockBackend())
     assert net2.nodes[0].algorithm.rng is net2.rng
+
+
+# ---------------------------------------------------------------------------
+# Dynamic twin of the snapshot-coverage lint rule (PR 17)
+# ---------------------------------------------------------------------------
+
+
+def _iter_state_instances(root):
+    """Walk the state graph exactly as the encoder would — registered
+    instances via ``_state_attrs`` (env attrs dropped), containers
+    element-wise — and yield every live ``_STATE_MODULES`` instance."""
+    from hbbft_tpu.utils.snapshot import _registry, _state_attrs
+
+    registered = set(_registry().values())
+    seen, out, stack = set(), [], [root]
+    while stack:
+        obj = stack.pop()
+        if id(obj) in seen:
+            continue
+        seen.add(id(obj))
+        if isinstance(obj, (list, tuple, set, frozenset)):
+            stack.extend(obj)
+        elif isinstance(obj, dict):
+            stack.extend(obj.keys())
+            stack.extend(obj.values())
+        elif type(obj) in registered:
+            out.append(obj)
+            stack.extend(v for _, v in _state_attrs(obj))
+    return out
+
+
+def test_dynamic_twin_live_state_instances_roundtrip_key_identical():
+    """After a composed gauntlet smoke cell, every live ``_STATE_MODULES``
+    instance snapshots and restores with an identical attribute-key set —
+    the dynamic twin of the static ``snapshot-coverage`` rule, catching
+    drift the AST pass cannot see (setattr through helpers, dynamically
+    added attrs, hooks installed by the environment mid-run).
+
+    The whole net round-trips once (one encode pass over every live
+    instance: any undeclared callable dies here), then per class a small
+    sample of instances is restored individually and its ``_state_attrs``
+    key set diffed against the live object's.  Every declared env attr
+    must resolve on the class, or restore would raise AttributeError."""
+    from hbbft_tpu.net.scenarios import Cell, run_cell
+    from hbbft_tpu.utils.snapshot import _state_attrs
+
+    sink = []
+    cell = Cell(
+        attack="equivocate", schedule="partition_heal", churn="era_flip",
+        crash="one_restart", traffic="one_x", n=4, epochs=6, seed=3,
+    )
+    run_cell(cell, net_sink=sink)
+    (net,) = sink
+
+    # one whole-graph encode/decode: the encoder rejects any callable
+    # that coverage drift let into state, package-wide
+    whole = load_node(save_node(net), net.backend)
+    assert type(whole) is type(net)
+
+    instances = _iter_state_instances(net)
+    assert len(instances) > 50, "state graph unexpectedly small"
+    by_class = {}
+    for obj in instances:
+        by_class.setdefault(type(obj), []).append(obj)
+    assert any(c.__name__ == "VirtualNet" for c in by_class)
+    assert any(c.__name__ == "CrashManager" for c in by_class)
+    assert any(c.__name__ == "QueueingHoneyBadger" for c in by_class)
+
+    for cls in sorted(by_class, key=lambda c: c.__qualname__):
+        for env_name in getattr(cls, "_SNAPSHOT_ENV_ATTRS", ()):
+            assert hasattr(cls, env_name), (
+                f"{cls.__qualname__} declares env attr {env_name!r} with no "
+                f"class-body default: restore would raise AttributeError"
+            )
+        for obj in by_class[cls][:3]:  # per-class sample: shapes are per-class
+            restored = load_node(save_node(obj), net.backend)
+            assert type(restored) is cls
+            live_keys = {n for n, _ in _state_attrs(obj)}
+            restored_keys = {n for n, _ in _state_attrs(restored)}
+            assert restored_keys == live_keys, (
+                cls.__qualname__,
+                sorted(restored_keys ^ live_keys),
+            )

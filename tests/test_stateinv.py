@@ -1,0 +1,303 @@
+"""Unit tests for the per-class mutable-state inventory (PR 17).
+
+The snapshot rule family (tests/test_lint.py) exercises the rules
+end-to-end; these tests pin the inventory substrate itself —
+init-path computation, value-shape classification, hook-call
+detection, env-declaration parsing — so a rule regression can be
+bisected to "inventory wrong" vs "rule logic wrong" in one run.
+"""
+
+from __future__ import annotations
+
+import textwrap
+from pathlib import Path
+
+from hbbft_tpu.analysis.dataflow import summarize_module
+from hbbft_tpu.analysis.engine import LintProject, ModuleSource
+from hbbft_tpu.analysis.stateinv import (
+    class_body_defaults,
+    init_path_methods,
+    inventory_class,
+    inventory_module,
+    parse_env_attrs,
+    state_module_paths,
+)
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+
+
+def _inventory(src: str, path: str = "hbbft_tpu/net/x.py"):
+    mod = ModuleSource(path, textwrap.dedent(src))
+    return inventory_module(mod)
+
+
+def _one(src: str):
+    invs = _inventory(src)
+    assert len(invs) == 1
+    return invs[0]
+
+
+# ---------------------------------------------------------------------------
+# init-path computation
+# ---------------------------------------------------------------------------
+
+
+def test_init_only_vs_runtime_classification():
+    inv = _one(
+        """\
+        class Node:
+            def __init__(self):
+                self.counters = {}
+                self._wire()
+
+            def _wire(self):
+                self.links = []
+
+            def on_deliver(self, msg):
+                self.last = msg
+        """
+    )
+    assert inv.attrs["counters"].init_only
+    assert inv.attrs["links"].init_only  # helper reachable only from __init__
+    assert not inv.attrs["last"].init_only
+    assert [w.context for w in inv.attrs["last"].runtime_writes] == [
+        "Node.on_deliver"
+    ]
+
+
+def test_helper_called_from_runtime_entry_is_not_init_path():
+    inv = _one(
+        """\
+        class Node:
+            def __init__(self):
+                self._reset()
+
+            def _reset(self):
+                self.buf = []
+
+            def crank(self):
+                self._reset()
+        """
+    )
+    # _reset has a non-init caller (crank), so its writes are runtime
+    assert not inv.attrs["buf"].init_only
+
+
+def test_no_caller_method_is_runtime_entry():
+    mod = ModuleSource(
+        "hbbft_tpu/net/x.py",
+        textwrap.dedent(
+            """\
+            class Node:
+                def __init__(self):
+                    pass
+
+                def _orphan(self):
+                    self.x = 1
+            """
+        ),
+    )
+    summary = summarize_module(mod)
+    cls = next(iter(summary.classes.values()))
+    assert init_path_methods(cls) == {"__init__"}
+
+
+def test_closure_writes_are_runtime_even_under_init():
+    inv = _one(
+        """\
+        class Node:
+            def __init__(self, pipe):
+                def deliver(res):
+                    self.last_res = res
+                pipe.on_result = deliver
+        """
+    )
+    assert not inv.attrs["last_res"].init_only
+
+
+# ---------------------------------------------------------------------------
+# value-shape classification
+# ---------------------------------------------------------------------------
+
+
+def test_value_kinds_lambda_def_bound_method_param_plain():
+    inv = _one(
+        """\
+        class Node:
+            def setup(self, on_commit):
+                self.a = lambda x: x
+                def helper(y):
+                    return y
+                self.b = helper
+                self.c = self.crank
+                self.d = on_commit
+                self.e = 42
+
+            def crank(self):
+                pass
+        """
+    )
+    kinds = {
+        name: inv.attrs[name].writes[0].value for name in "abcde"
+    }
+    assert kinds == {
+        "a": "lambda",
+        "b": "def",
+        "c": "bound-method",
+        "d": "param",
+        "e": "plain",
+    }
+    assert inv.attrs["d"].writes[0].params == ("on_commit",)
+    assert inv.attrs["a"].writes[0].callable_kind == "lambda"
+    assert inv.attrs["b"].writes[0].callable_kind == "nested function"
+    assert inv.attrs["c"].writes[0].callable_kind == "bound method"
+    assert inv.attrs["d"].writes[0].callable_kind is None
+
+
+def test_param_derived_expression_still_param():
+    inv = _one(
+        """\
+        class Node:
+            def __init__(self, hooks):
+                self.hooks = tuple(hooks)
+        """
+    )
+    w = inv.attrs["hooks"].writes[0]
+    assert w.value == "param"
+    assert w.params == ("hooks",)
+
+
+# ---------------------------------------------------------------------------
+# hook-call detection
+# ---------------------------------------------------------------------------
+
+
+def test_direct_hook_call_detected_methods_excluded():
+    inv = _one(
+        """\
+        class Node:
+            def commit(self, batch):
+                self.on_commit(batch)
+                self.crank()
+
+            def crank(self):
+                pass
+        """
+    )
+    assert "on_commit" in inv.hook_calls
+    assert "crank" not in inv.hook_calls  # real method, not a hook
+
+
+def test_iterated_hook_call_anchored_at_for_iter():
+    inv = _one(
+        """\
+        class Node:
+            def fire(self, entry):
+                for fn in self.listeners:
+                    fn(entry)
+                for item in self.rows:
+                    item.append(entry)
+        """
+    )
+    assert inv.hook_calls == {"listeners": 3}  # rows: loopvar never called
+
+
+# ---------------------------------------------------------------------------
+# declarations, defaults, is_real
+# ---------------------------------------------------------------------------
+
+
+def test_parse_env_attrs_and_class_defaults():
+    import ast
+
+    tree = ast.parse(
+        textwrap.dedent(
+            """\
+            class Node:
+                tracer = None
+                limit: int = 8
+                bare: int
+                _SNAPSHOT_ENV_ATTRS = ("tracer", "sink")
+            """
+        )
+    )
+    cls = tree.body[0]
+    names, line = parse_env_attrs(cls)
+    assert names == ("tracer", "sink")
+    assert line == 5
+    defaults = class_body_defaults(cls)
+    assert "tracer" in defaults and "limit" in defaults
+    assert "bare" not in defaults  # bare annotation is not a default
+
+
+def test_is_real_distinguishes_dead_env_declaration():
+    inv = _one(
+        """\
+        class Node:
+            tracer = None
+            _SNAPSHOT_ENV_ATTRS = ("tracer", "ghost")
+
+            def crank(self):
+                if self.tracer is not None:
+                    self.tracer.span("x")
+        """
+    )
+    assert inv.env_attrs == ("tracer", "ghost")
+    assert inv.is_real("tracer")
+    assert not inv.is_real("ghost")
+
+
+# ---------------------------------------------------------------------------
+# registry resolution and memoization
+# ---------------------------------------------------------------------------
+
+
+def test_state_module_paths_from_disk_and_from_loaded_module(tmp_path):
+    # from disk (repo_root fallback — the unit-test path)
+    reg = tmp_path / "hbbft_tpu" / "utils"
+    reg.mkdir(parents=True)
+    (reg / "snapshot.py").write_text(
+        '_STATE_MODULES = ("hbbft_tpu.protocols.x", "hbbft_tpu.net.y")\n',
+        encoding="utf-8",
+    )
+    project = LintProject(tmp_path, {})
+    assert state_module_paths(project) == (
+        "hbbft_tpu/protocols/x.py",
+        "hbbft_tpu/net/y.py",
+    )
+    # from the loaded project module (the full-run path): the loaded
+    # source wins over whatever is on disk
+    mod = ModuleSource(
+        "hbbft_tpu/utils/snapshot.py",
+        '_STATE_MODULES = ("hbbft_tpu.core.z",)\n',
+    )
+    project2 = LintProject(tmp_path, {mod.path: mod})
+    assert state_module_paths(project2) == ("hbbft_tpu/core/z.py",)
+    # missing registry entirely: empty scope, rules no-op
+    assert state_module_paths(LintProject(tmp_path / "nowhere", {})) == ()
+
+
+def test_inventory_module_memoized_per_source():
+    mod = ModuleSource(
+        "hbbft_tpu/net/x.py",
+        "class Node:\n    def __init__(self):\n        self.x = 1\n",
+    )
+    assert inventory_module(mod) is inventory_module(mod)
+
+
+def test_real_registry_classes_inventory_clean():
+    """Smoke: inventory every real _STATE_MODULES file — no crashes, and
+    CrashManager's well-known attrs classify as expected."""
+    project = LintProject(REPO_ROOT, {})
+    paths = state_module_paths(project)
+    assert len(paths) >= 30
+    crash = None
+    for rel in paths:
+        p = REPO_ROOT / rel
+        mod = ModuleSource(rel, p.read_text(encoding="utf-8"))
+        for inv in inventory_module(mod):
+            if rel.endswith("net/crash.py") and inv.name == "CrashManager":
+                crash = inv
+    assert crash is not None
+    assert "restart_listeners" in crash.env_attrs
+    assert crash.is_real("restart_listeners")

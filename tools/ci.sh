@@ -6,10 +6,13 @@
 # Runs, in order:
 #   1. tools/lint.py --ci   — the custom AST rule families (seam-race,
 #      byzantine-input, determinism, handler-exhaustiveness,
-#      tracer-safety, deferred-fetch, glv-table-order, plus the
-#      stale-suppression pass) against tools/lint_baseline.json, and
-#      ruff when the binary is installed (skipped cleanly otherwise —
-#      no dependency is downloaded).
+#      tracer-safety, deferred-fetch, glv-table-order, snapshot-coverage,
+#      replay-purity, hook-detachment, plus the stale-suppression pass)
+#      against tools/lint_baseline.json, and ruff when the binary is
+#      installed (skipped cleanly otherwise — no dependency is
+#      downloaded).  Findings are also written as schema-pinned JSON
+#      (--json) and the CI annotations below are emitted from that
+#      document, never by parsing the human text.
 #   2. tools/race_explorer.py --smoke — the schedule-space smoke sweep
 #      over the pipeline / traffic-hook / virtualnet seams.
 #   3. tools/soak.py --smoke — one composed-gauntlet cell (equivocator x
@@ -37,7 +40,19 @@ PY="${PYTHON:-python}"
 rc=0
 
 echo "== ci: lint (custom rule families + ruff if installed) =="
-"$PY" tools/lint.py --ci || rc=1
+LINT_JSON="${TMPDIR:-/tmp}/hbbft_ci_lint.json"
+"$PY" tools/lint.py --ci --json "$LINT_JSON" || rc=1
+# CI annotations come from the schema-pinned JSON document, not from the
+# human-readable transcript above (stable even if the text format moves)
+"$PY" - "$LINT_JSON" <<'ANNOTATE'
+import json, sys
+doc = json.load(open(sys.argv[1], encoding="utf-8"))
+assert doc["schema"] == "hbbft-tpu-lint/1", doc["schema"]
+for f in doc["new"]:
+    print(
+        "::error file={path},line={line},col={col},title=lint:{rule}::{message}".format(**f)
+    )
+ANNOTATE
 
 echo "== ci: schedule-space race explorer (smoke sweep) =="
 "$PY" tools/race_explorer.py --smoke || rc=1

@@ -7,6 +7,7 @@ Usage::
     python tools/lint.py --diff          # only files changed vs git HEAD
     python tools/lint.py --baseline      # rewrite the grandfathered baseline
     python tools/lint.py --ci            # ruff (if installed) + custom rules
+    python tools/lint.py --json OUT      # machine-readable findings ("-": stdout)
     python tools/lint.py path/a.py ...   # explicit file list
 
 Exit status is non-zero iff there are findings beyond the checked-in
@@ -24,6 +25,7 @@ work and finishes in seconds on CPU (no JAX import).
 from __future__ import annotations
 
 import argparse
+import json
 import shutil
 import subprocess
 import sys
@@ -40,6 +42,28 @@ from hbbft_tpu.analysis.engine import (  # noqa: E402
 )
 
 BASELINE_PATH = REPO_ROOT / "tools" / "lint_baseline.json"
+
+#: schema identifier pinned by tests/test_lint.py — bump only with a
+#: matching consumer update (tools/ci.sh parses this, not the human text)
+JSON_SCHEMA = "hbbft-tpu-lint/1"
+
+
+def findings_document(new, grandfathered: int) -> dict:
+    """Machine-readable findings: stable sort, schema-pinned shape."""
+    return {
+        "schema": JSON_SCHEMA,
+        "new": [
+            {
+                "rule": f.rule,
+                "path": f.path,
+                "line": f.line,
+                "col": f.col,
+                "message": f.message,
+            }
+            for f in sorted(new, key=Finding.sort_key)
+        ],
+        "grandfathered": grandfathered,
+    }
 
 
 def _git_changed_files() -> list:
@@ -94,6 +118,14 @@ def main(argv=None) -> int:
         action="store_true",
         help="also run ruff (if installed); exit codes are merged",
     )
+    ap.add_argument(
+        "--json",
+        metavar="OUT",
+        default=None,
+        help="write machine-readable findings (schema "
+        f"{JSON_SCHEMA!r}) to OUT; '-' writes JSON to stdout and moves "
+        "the human summary to stderr",
+    )
     args = ap.parse_args(argv)
 
     if args.files:
@@ -136,12 +168,21 @@ def main(argv=None) -> int:
     new = baseline.new_findings(findings)
     grandfathered = len(findings) - len(new)
 
+    human_out = sys.stdout
+    if args.json is not None:
+        doc = json.dumps(findings_document(new, grandfathered), indent=2)
+        if args.json == "-":
+            print(doc)
+            human_out = sys.stderr
+        else:
+            Path(args.json).write_text(doc + "\n", encoding="utf-8")
+
     for f in new:
-        print(f.render())
+        print(f.render(), file=human_out)
     summary = f"lint: {len(new)} new finding(s)"
     if grandfathered:
         summary += f", {grandfathered} grandfathered"
-    print(summary)
+    print(summary, file=human_out)
 
     rc = 1 if new else 0
     if args.ci:
