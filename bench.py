@@ -2105,6 +2105,70 @@ def bench_array_engine_n100_tpu() -> dict:
                 os.environ[k] = v
 
 
+def bench_mesh_scaling() -> dict:
+    """PR 18 scale-out row: the per-device pipelined dispatcher
+    (parallel/shardpipe.py) across virtual CPU meshes of 1/2/4/8 devices.
+    Each mesh size runs in its own subprocess (tools/mesh_probe.py — the
+    XLA host-platform device count is fixed at JAX init) and reports
+    STRUCTURAL facts: deterministic round-robin placements, balanced
+    per-device dispatch tallies, imbalance 1.0 for divisible chunk
+    counts, bit-correct results.  Virtual-mesh chunks/s is NOT a
+    scale-out measurement (host devices share the physical cores —
+    PERF.md round 14); the real-mesh number comes from the window
+    runbook's mesh_scaling step.  Knobs: BENCH_MESH_SIZES /
+    BENCH_MESH_CHUNKS / BENCH_MESH_LANES."""
+    import subprocess
+
+    sizes = [
+        int(x)
+        for x in os.environ.get("BENCH_MESH_SIZES", "1,2,4,8").split(",")
+    ]
+    chunks = int(os.environ.get("BENCH_MESH_CHUNKS", "64"))
+    probe = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools", "mesh_probe.py"
+    )
+    t0 = time.perf_counter()
+    meshes = []
+    failed = []
+    for k in sizes:
+        env = dict(os.environ)
+        env["BENCH_MESH_DEVICES"] = str(k)
+        env["BENCH_MESH_CHUNKS"] = str(chunks)
+        # the probe pins its own device count and forces JAX_PLATFORMS=cpu
+        env.pop("BENCH_ONLY", None)
+        proc = subprocess.run(
+            [sys.executable, probe],
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=300,
+        )
+        if proc.returncode != 0 or not proc.stdout.strip():
+            failed.append({"devices": k, "error": proc.stderr[-500:]})
+            continue
+        meshes.append(json.loads(proc.stdout.strip().splitlines()[-1]))
+    dt = time.perf_counter() - t0
+    structural_ok = bool(meshes) and not failed and all(
+        m["placements_ok"] and m["balanced"] and m["results_ok"]
+        for m in meshes
+    )
+    widest = meshes[-1] if meshes else {}
+    return {
+        "metric": "mesh_scaling",
+        "value": widest.get("chunks_per_s", 0.0),
+        "unit": f"chunks/s@{widest.get('devices', 0)}dev",
+        "vs_baseline": 1.0,
+        "baseline": "estimated",
+        "virtual_mesh": True,  # structural only — never a scale-out claim
+        "chunks": chunks,
+        "meshes": meshes,
+        "all_ok": structural_ok,
+        "imbalance_max": max((m["imbalance"] for m in meshes), default=0.0),
+        "failed": failed,
+        "wall_s": round(dt, 2),
+    }
+
+
 # Rough per-bench wall-cost estimates on TPU, seconds (measured: round-4
 # window logs — step 2's seven rows took ~17 min incl. compiles; n100
 # real-crypto per-epoch from the round-5 step-4 capture).  Used only by
@@ -2117,7 +2181,7 @@ _BENCH_EST_S = {
     "array_n256_soak": 300, "array_n100_dedup": 120, "array_n64_coin": 240,
     "array_n100": 300, "glv_ladder": 180, "adv_matrix": 600,
     "scenario_matrix": 60, "qhb_traffic": 420, "crash_matrix": 120,
-    "slo_traffic": 420,
+    "slo_traffic": 420, "mesh_scaling": 120,
 }
 
 
@@ -2159,6 +2223,9 @@ def _plan_benches(only, platform: str, budget: float) -> list:
         plan.append(("glv_ladder", bench_glv_ladder))
         plan.append(("scenario_matrix", bench_scenario_matrix))
         plan.append(("crash_matrix", bench_crash_matrix))
+        # per-device dispatcher structure row (PR 18) — cheap, ahead of
+        # the traffic curves so a timeout still captures it
+        plan.append(("mesh_scaling", bench_mesh_scaling))
         # traffic curve: new measured axis, ahead of the support rows
         plan.append(("qhb_traffic", bench_qhb_traffic))
         # control plane: the adaptive-vs-fixed-B SLO row rides with it
@@ -2202,6 +2269,7 @@ def _plan_benches(only, platform: str, budget: float) -> list:
             ("adv_matrix", bench_adv_matrix),
             ("scenario_matrix", bench_scenario_matrix),
             ("crash_matrix", bench_crash_matrix),
+            ("mesh_scaling", bench_mesh_scaling),
             ("qhb_traffic", bench_qhb_traffic),
             ("slo_traffic", bench_slo_traffic),
             ("glv_ladder", bench_glv_ladder),

@@ -582,6 +582,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             interval_s=args.heartbeat if args.heartbeat else float("inf"),
             stall_timeout_s=args.stall_timeout,
             counters_fn=backend.counters.snapshot,
+            # mesh backends report per-device dispatch balance per beat
+            shard_stats_fn=getattr(backend, "shard_stats", None),
         )
     print(
         f"hbbft_tpu simulation: N={args.num_nodes} f={args.num_faulty} "

@@ -23,6 +23,14 @@ bug the seam discipline exists to prevent:
   the live mempools MID-EPOCH, so the next epoch's contribution
   sampling depends on the resolution order (the traffic-hook seam
   violated).  Batches themselves diverge.
+* ``shard`` (PR 18) — decrypt chunks ride the PER-DEVICE pipeline but
+  scatter their results through a cursor advanced in RESOLUTION order
+  instead of writing at their submission offsets.  Per-device queues
+  are FIFO, so the bug is invisible until chunks on DIFFERENT devices
+  resolve out of submission order — exactly the freedom the shard
+  explorer target schedules over.  The cursor is also the minimal
+  submit-write/resolve-read crossing the static ``seam-race`` rule
+  flags (tests/test_lint.py maps this module into the rule's scope).
 
 These classes are exercised only by the explorer and the lint tests —
 nothing in the production paths imports them.
@@ -33,6 +41,7 @@ from __future__ import annotations
 from typing import Any, Callable, Dict, List, Sequence
 
 from hbbft_tpu.crypto.backend import MockBackend
+from hbbft_tpu.analysis.schedules import ShardedMockBackend
 
 
 class AccumulatingResolveBackend(MockBackend):
@@ -105,6 +114,49 @@ class SubmitReadsResolveBackend(MockBackend):
         return {"probe_acc": self._probe_acc}
 
 
+class ShardOrderScatterBackend(ShardedMockBackend):
+    """Seeded bug 4: result scatter keyed by resolution order.
+
+    ``decrypt_shares_batch`` submits each chunk to its reserved device
+    but delivers through a shared cursor that advances as chunks
+    RESOLVE — so a chunk's results land at whatever offset the schedule
+    put the cursor at, not at the chunk's submission offset.  Correct
+    whenever cross-device resolution happens to equal submission order;
+    any other interleaving permutes the shares and trips the epoch's
+    decrypt-equality invariant.
+    """
+
+    def decrypt_shares_batch(self, items):
+        out: List[Any] = [None] * len(items)
+        step = self.pipeline_chunk or len(items) or 1
+        b = self._batch_seq
+        self._batch_seq += 1
+        # BUG (seam-race shape): submit-path write of the cursor the
+        # resolve-path deliveries below read and advance
+        self._scatter_cursor = 0
+        for ci, lo in enumerate(range(0, len(items), step)):
+            chunk = items[lo : lo + step]
+
+            def deliver(res):
+                # BUG: scatter keyed by resolution order, not by the
+                # chunk's submission offset
+                out[self._scatter_cursor : self._scatter_cursor + len(res)] = res
+                self._scatter_cursor += len(res)
+
+            self._pipe.reserve_device()
+            self._pipe.submit(
+                lambda chunk=chunk: [
+                    sk.decrypt_share_unchecked(ct) for sk, ct in chunk
+                ],
+                fetch=None,
+                kind=f"b{b}.c{ci}",
+                items=len(chunk),
+                on_result=deliver,
+            )
+        self._pipe.flush()
+        return out
+
+
 def mid_epoch_mempool_listener(driver) -> Callable:
     """Seeded bug 3: a listener mutating mempool state mid-epoch.
 
@@ -157,10 +209,19 @@ def target_runner(name: str):
             )
 
         return run_listener
+    if name == "shard":
+
+        def run_shard(controller, tracker, n, seed):
+            return schedules.run_shard_target(
+                controller, tracker, n, seed,
+                backend_factory=ShardOrderScatterBackend,
+            )
+
+        return run_shard
     raise KeyError(f"unknown mutant {name!r}")
 
 
-MUTANT_NAMES = ("accum", "counter", "listener")
+MUTANT_NAMES = ("accum", "counter", "listener", "shard")
 
 
 # ---------------------------------------------------------------------------

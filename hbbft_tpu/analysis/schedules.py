@@ -48,6 +48,8 @@ import json
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, FrozenSet, List, Optional, Sequence, Tuple
 
+from hbbft_tpu.crypto.backend import MockBackend
+
 # ---------------------------------------------------------------------------
 # Decision traces
 # ---------------------------------------------------------------------------
@@ -204,8 +206,16 @@ class RaceTracker:
     def pipe_submit(self, p) -> None:
         kind = p.kind or f"anon{len(self.events)}"
         batch = kind.split(".", 1)[0]
+        # per-device-queue footprint (sharded pipeline, PR 18): a submit
+        # APPENDS to its device's queue, a resolve POPS it — same-device
+        # entries are thereby ordered (a device stream completes FIFO)
+        # while cross-device entries stay concurrent, which is exactly
+        # the schedule freedom the shard choose() axis explores
+        dev = getattr(p, "device", None)
         ev = self.record(
-            f"submit:{kind}", "main", "submit", writes=(), reads=(),
+            f"submit:{kind}", "main", "submit",
+            writes=(("devq", dev),) if dev is not None else (),
+            reads=(),
         )
         self._pending[id(p)] = ev.index
         # batch identity for the resolve's footprint
@@ -215,14 +225,18 @@ class RaceTracker:
         kind = p.kind or "anon"
         batch = kind.split(".", 1)[0]
         cause = self._pending.pop(id(p), None)
+        # object-granular: every chunk of one batch writes "the
+        # batch's result object" — deliberately coarser than the
+        # disjoint slot ranges, the way a static footprint would be
+        writes = [("batch", batch)]
+        dev = getattr(p, "device", None)
+        if dev is not None:
+            writes.append(("devq", dev))
         self.record(
             f"resolve:{kind}",
             f"chunk:{kind}",
             "resolve",
-            # object-granular: every chunk of one batch writes "the
-            # batch's result object" — deliberately coarser than the
-            # disjoint slot ranges, the way a static footprint would be
-            writes=(("batch", batch),),
+            writes=tuple(writes),
             causes=(cause,) if cause is not None else (),
         )
 
@@ -563,6 +577,105 @@ def run_virtualnet_target(
     )
 
 
+class ShardedMockBackend(MockBackend):
+    """MockBackend whose simulated-async chunks ride the PER-DEVICE
+    sharded pipeline (parallel/shardpipe.py) — the tier-1/no-JAX stand-in
+    for MeshBackend's whole-chunk-per-device dispatch.  Each chunk
+    reserves a (recorded) device before submitting; ``finish()`` drains
+    the device queues under the pipe's ``choose_shard`` hook, which the
+    shard explorer target wires to the controller's choose() axis.  The
+    default hook resolves the LAST ready device first — deterministic
+    cross-device out-of-order, per-device FIFO — so plain tier-1 use
+    exercises shard reordering without a controller."""
+
+    #: virtual device count: >1 so cross-device order exists, small so
+    #: the explorer's choice arity stays tractable at smoke budgets
+    n_devices = 4
+
+    def __init__(self) -> None:
+        super().__init__()
+        from hbbft_tpu.parallel.shardpipe import ShardedDispatchPipeline
+
+        self._pipe = ShardedDispatchPipeline(
+            self.n_devices, counters=None, tracer_ref=None,
+            depth_fn=lambda: 1 << 30,
+        )
+        self._pipe.choose_shard = lambda ready: len(ready) - 1
+
+    def _piped_submit(self, items, compute):
+        # base body + a device reservation per chunk (the shard seam)
+        step = self.pipeline_chunk or len(items) or 1
+        out = [None] * len(items)
+        b = self._batch_seq
+        self._batch_seq += 1
+        for ci, lo in enumerate(range(0, len(items), step)):
+            chunk = items[lo : lo + step]
+
+            def deliver(res, lo=lo):
+                out[lo : lo + len(res)] = res
+                for cb in self.chunk_listeners:
+                    cb(lo, res)
+
+            self._pipe.reserve_device()
+            self._pipe.submit(
+                lambda chunk=chunk: compute(chunk), fetch=None,
+                kind=f"b{b}.c{ci}", items=len(chunk),
+                on_result=deliver,
+            )
+
+        def finish():
+            self._pipe.flush()
+            return out
+
+        return out, finish
+
+
+def run_shard_target(
+    controller: ScheduleController,
+    tracker: RaceTracker,
+    n: int,
+    seed: int,
+    backend_factory: Optional[Callable[[], Any]] = None,
+    epochs: int = 2,
+    coin_rounds: int = 1,
+) -> RunResult:
+    """The cross-shard completion-order seam (PR 18): honest lockstep
+    epochs with chunks landing on per-device queues, the explorer
+    choosing which device's head resolves next at every drain step.
+    Placement (recorded) and per-device dispatch tallies ride the
+    fingerprint — they are submit-path program state, so any schedule
+    leaking into them is itself a divergence."""
+    from hbbft_tpu.engine.array_engine import ArrayHoneyBadgerNet
+
+    backend = (backend_factory or ShardedMockBackend)()
+    items = n * n * max(1, n - 1)
+    backend.pipeline_chunk = max(1, items // 4)
+    backend._pipe.probe = tracker
+    backend._pipe.choose_shard = lambda ready: controller.choose(
+        len(ready), "shard", candidates=[f"dev{d}" for d in ready]
+    )
+    net = ArrayHoneyBadgerNet(
+        range(n), backend=backend, seed=seed, coin_rounds=coin_rounds
+    )
+    error: Optional[BaseException] = None
+    batches: List[Any] = []
+    try:
+        batches = net.run_epochs(epochs)
+    except Exception as e:  # divergence shows up as a raised invariant
+        error = e
+    extra: Dict[str, Any] = {
+        "dev_dispatches": list(backend._pipe.dev_dispatches),
+        "placements_sha": sha(backend._pipe.placements),
+    }
+    if hasattr(backend, "race_extra"):
+        extra.update(backend.race_extra())
+    parts = _engine_parts(net, batches, error, extra)
+    return RunResult(
+        parts, list(controller.trace), list(controller.points),
+        tracker.canonical_form(), tracker.events,
+    )
+
+
 def _mutant_target(name: str):
     from hbbft_tpu.analysis import mutations
 
@@ -575,6 +688,7 @@ def target_runner(name: str):
         "pipeline": run_pipeline_target,
         "traffic": run_traffic_target,
         "virtualnet": run_virtualnet_target,
+        "shard": run_shard_target,
     }
     if name in honest:
         return honest[name]
@@ -583,14 +697,15 @@ def target_runner(name: str):
     raise KeyError(f"unknown explorer target {name!r}")
 
 
-TARGET_NAMES = ("pipeline", "traffic", "virtualnet")
+TARGET_NAMES = ("pipeline", "traffic", "virtualnet", "shard")
 
 #: (target, n, max_runs) triples of the tier-1 smoke sweep — small but
-#: covering all three seams; ~1 s on one CPU core
+#: covering all four seams; ~1 s on one CPU core
 SMOKE_PLAN = (
     ("pipeline", 4, 40),
     ("traffic", 4, 25),
     ("virtualnet", 4, 40),
+    ("shard", 4, 40),
 )
 
 #: the slow full sweep (tests/test_race_explorer.py slow arm + PERF.md
@@ -604,6 +719,8 @@ FULL_PLAN = (
     ("traffic", 7, 100),
     ("virtualnet", 4, 250),
     ("virtualnet", 7, 150),
+    ("shard", 4, 250),
+    ("shard", 7, 100),
 )
 
 

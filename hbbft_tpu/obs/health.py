@@ -393,6 +393,12 @@ class HealthReporter:
     ``gate_fn`` (e.g. ``net.critpath.gate_line`` when a critical-path
     recorder is attached) contributes the latest gating one-liner to
     every heartbeat and stall record.
+    ``shard_stats_fn`` (e.g. ``backend.shard_stats`` on a MeshBackend)
+    contributes the mesh scale-out health — the cumulative
+    ``shard_imbalance`` ratio (max/mean per-device dispatches; 1.0 =
+    balanced) and the per-device dispatch tallies — to every heartbeat,
+    so a soak run surfaces a skewing placement policy the same way it
+    surfaces a stalling quorum.
     """
 
     def __init__(
@@ -404,12 +410,14 @@ class HealthReporter:
         sink: Callable[[Dict[str, Any]], None] = _print_sink,
         clock: Callable[[], float] = time.monotonic,
         gate_fn: Optional[Callable[[], Optional[str]]] = None,
+        shard_stats_fn: Optional[Callable[[], Dict[str, Any]]] = None,
     ) -> None:
         self.interval_s = interval_s
         self.stall_timeout_s = stall_timeout_s
         self.counters_fn = counters_fn
         self.stall_report_fn = stall_report_fn
         self.gate_fn = gate_fn
+        self.shard_stats_fn = shard_stats_fn
         self.sink = sink
         self.clock = clock
         t = clock()
@@ -536,6 +544,14 @@ class HealthReporter:
                 dev = delta.get("device_seconds", 0.0)
                 if ovl and dev > 0:
                     beat["overlap_fraction"] = round(ovl / dev, 4)
+        if self.shard_stats_fn is not None:
+            try:
+                st = self.shard_stats_fn()
+            except Exception:  # a heartbeat must never raise on a hook
+                st = None
+            if st:
+                beat["shard_imbalance"] = st.get("shard_imbalance")
+                beat["shard_dispatches"] = st.get("shard_dispatches")
         beat.update(extra)
         self._add_gate(beat)
         self.beats.append(beat)

@@ -298,8 +298,12 @@ class TpuBackend(CryptoBackend):
                 curve.glv_table_field_muls(bits) * lanes * ladders_per_lane
             )
 
-    def _place(self, tree):
-        """Placement hook for jitted-call inputs (MeshBackend shards)."""
+    def _place(self, tree, pipelined: bool = False):
+        """Placement hook for jitted-call inputs.  ``pipelined=True``
+        marks a chunk headed for a DEFERRED-fetch dispatch — MeshBackend
+        lands such chunks whole on one reserved device (per-device
+        pipelining, parallel/shardpipe.py) and shards only sync
+        dispatches SPMD; the single-chip backend ignores both."""
         return tree
 
     def _to_device_gather(self, points, to_device, transform=None):
@@ -416,7 +420,7 @@ class TpuBackend(CryptoBackend):
             Q2 = self._to_device_gather(
                 [q[3] for q in chunk], pairing.g2_affine_to_device
             )
-            placed = self._place((P1, Q1, P2, Q2))
+            placed = self._place((P1, Q1, P2, Q2), pipelined=True)
 
         def deliver(f, base=base, n=n):
             if hostpipe_enabled():
@@ -648,7 +652,9 @@ class TpuBackend(CryptoBackend):
             # deferred dispatch.  Dispatch counts are identical to the
             # sync loop: same rounds, same chunks, only the first fetch
             # is deferred.
-            placed, n_items = self._rlc_round_stage(pending, build_group_arrays)
+            placed, n_items = self._rlc_round_stage(
+                pending, build_group_arrays, pipelined=True
+            )
             holder: List[Any] = []
             self.counters.device_dispatches += 1
             self._dispatch_async(
@@ -693,10 +699,13 @@ class TpuBackend(CryptoBackend):
             )
         self._pipe.flush()
 
-    def _rlc_round_stage(self, pending, build_group_arrays):
+    def _rlc_round_stage(self, pending, build_group_arrays,
+                         pipelined: bool = False):
         """Stage one bisection round's arrays: pad groups, draw fresh RLC
         coefficients (one flattened ``scalars_to_bits`` call for the
-        whole (g, k) matrix), build the group point arrays, place."""
+        whole (g, k) matrix), build the group point arrays, place.
+        ``pipelined`` marks the deferred first round (PR 5) so the mesh
+        backend can land it whole on one device."""
         with self._host_assembly():
             k = _bucket(max(len(grp) for grp in pending))
             g = self._pad_bucket(len(pending))
@@ -734,7 +743,9 @@ class TpuBackend(CryptoBackend):
             ).reshape(g, k, -1)
 
             args = build_group_arrays(padded, g, k)
-            placed = self._place(tuple(args) + (jnp.asarray(rbits),))
+            placed = self._place(
+                tuple(args) + (jnp.asarray(rbits),), pipelined=pipelined
+            )
         # two RLC_BITS-wide w2 ladders per lane (share + key combine);
         # the 64-bit coefficients stay on the classic path — GLV
         # decomposition has nothing to split below 2^127
@@ -1194,7 +1205,9 @@ class TpuBackend(CryptoBackend):
                 negs = np.concatenate([negs, np.repeat(negs[:1], b - n, axis=0)])
                 pts = pts + [pts[0]] * (b - n)
             P = self._to_device_gather(pts, to_device)
-            placed = self._place((P, jnp.asarray(bits), jnp.asarray(negs)))
+            placed = self._place(
+                (P, jnp.asarray(bits), jnp.asarray(negs)), pipelined=True
+            )
         self._count_ladder(bits, n, glv=bits.ndim == 3)
         self.counters.device_dispatches += 1
 
@@ -1359,7 +1372,7 @@ class TpuBackend(CryptoBackend):
             )
             bits = jnp.asarray(np.stack(bits_rows))
             negs = jnp.asarray(np.stack(negs_rows))
-            placed = self._place((P, bits, negs))
+            placed = self._place((P, bits, negs), pipelined=True)
         # bits_rows[0] is the host numpy prep output — shape/ndim only
         self._count_ladder(
             bits_rows[0], len(share_dicts) * k, glv=bits_rows[0].ndim == 3,

@@ -228,6 +228,18 @@ class DispatchPipeline:
         for p in pending:
             p.resolve()
 
+    def _track_for(self, p: PendingDispatch) -> str:
+        """Tracer track a resolved dispatch's span lands on.  The shard
+        pipeline (parallel/shardpipe.py) overrides this so sharded
+        entries span their DEVICE's ``device/<n>`` track instead of the
+        in-flight slot's."""
+        return "device" if p.slot is None else f"device/{p.slot}"
+
+    def _bill_device(self, p: PendingDispatch, dt: float) -> None:
+        """Per-device attribution hook (no-op on the single-queue
+        pipeline): called once per resolve with the same [t0, t1]
+        interval the counters and tracer bill."""
+
     def _resolve(self, p: PendingDispatch):
         if p.done:
             return p.value
@@ -242,12 +254,13 @@ class DispatchPipeline:
         t1 = time.perf_counter()
         self._fetch_blocked += t1 - t_req
         p._raw = None  # release the device buffer reference
+        dt = t1 - p.t0
+        self._bill_device(p, dt)
         c = self._counters
         if c is not None:
             # host-bucket attribution (obs/hostbuckets.py): the fetch
             # itself is device WAIT, not host work — regions subtract it
             c.fetch_blocked_seconds += t1 - t_req
-            dt = t1 - p.t0
             c.device_seconds += dt
             if p.kind:
                 name = "device_seconds_" + p.kind
@@ -264,7 +277,7 @@ class DispatchPipeline:
                 c.pipelined_dispatches += 1
         tr = self._tracer_ref() if self._tracer_ref is not None else None
         if tr is not None:
-            track = "device" if p.slot is None else f"device/{p.slot}"
+            track = self._track_for(p)
             tr.complete(
                 f"dispatch:{p.kind or 'unkinded'}", p.t0, t1,
                 cat=p.kind or "unkinded", track=track, items=p.items,

@@ -1,17 +1,35 @@
-from hbbft_tpu.parallel.backend import MeshBackend
-from hbbft_tpu.parallel.mesh import (
-    BATCH_AXIS,
-    device_mesh,
-    shard_batch,
-    sharded_combine_g2_fn,
-    sharded_product2_fn,
-)
+"""Mesh scale-out package.
 
-__all__ = [
-    "BATCH_AXIS",
-    "MeshBackend",
-    "device_mesh",
-    "shard_batch",
-    "sharded_combine_g2_fn",
-    "sharded_product2_fn",
-]
+Lazy exports (PEP 562): ``shardpipe`` is import-light by design — the
+race explorer and tier-1 drive :class:`ShardedDispatchPipeline` with
+MockBackend entries on no-JAX paths (tools/ci.sh budget) — so importing
+``hbbft_tpu.parallel.shardpipe`` must not drag ``backend``/``mesh`` (and
+therefore jax) in through this package init.
+"""
+
+import importlib
+
+_LAZY = {
+    "MeshBackend": "hbbft_tpu.parallel.backend",
+    "BATCH_AXIS": "hbbft_tpu.parallel.mesh",
+    "device_mesh": "hbbft_tpu.parallel.mesh",
+    "shard_batch": "hbbft_tpu.parallel.mesh",
+    "sharded_combine_g2_fn": "hbbft_tpu.parallel.mesh",
+    "sharded_product2_fn": "hbbft_tpu.parallel.mesh",
+    "ShardedDispatchPipeline": "hbbft_tpu.parallel.shardpipe",
+    "placement_policy": "hbbft_tpu.parallel.shardpipe",
+    "shardpipe_enabled": "hbbft_tpu.parallel.shardpipe",
+}
+
+__all__ = sorted(_LAZY)
+
+
+def __getattr__(name):
+    mod = _LAZY.get(name)
+    if mod is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    return getattr(importlib.import_module(mod), name)
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_LAZY))

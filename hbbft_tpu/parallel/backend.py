@@ -1,28 +1,40 @@
 """MeshBackend — the multi-chip crypto backend (ICI/DCN scaling axis).
 
 TpuBackend resolves whole verification/combination batches in single-chip
-jitted dispatches; MeshBackend is the same backend with every batch/group
-axis sharded over a ``jax.sharding.Mesh`` (BASELINE config 5: "QHB N=256
-sustained").  All sharded paths are data-parallel over the item/group
-axis — per-item pairing work and per-item Lagrange ladders partition
-across chips with no cross-chip traffic until the host gathers results.
-The cross-shard Jacobian reduction (one combine whose SHARES span chips,
-the literal "ICI all-gather of shares") is the separate
-``parallel/mesh.sharded_combine_g2_fn`` kernel, exercised by the
-multichip dryrun; protocol workloads batch many independent combines, so
-the data-parallel form is the one the backend seam dispatches.
+jitted dispatches; MeshBackend scales the same backend across a
+``jax.sharding.Mesh`` (BASELINE config 5: "QHB N=256 sustained").  Since
+PR 18 it does so with a PER-DEVICE PIPELINED dispatcher
+(parallel/shardpipe.py): every lane-capped chunk of the pipelined chunk
+streams — pairing checks, sign/decrypt/DKG ladders, Lagrange combines,
+the RLC deferred first round — lands WHOLE on one device picked by a
+recorded round-robin/least-loaded policy, with a bounded in-flight queue
+per device.  Whole-chunk placement keeps each dispatch's lanes dense
+(splitting a small chunk 8 ways burns launch overhead and pad lanes) and
+keeps all devices busy concurrently instead of synchronized.
+
+SYNC dispatches (RLC bisection rounds, single combines — control flow
+needs the result immediately) still shard their batch axis SPMD over the
+whole mesh: one wide collective step is exactly right when the host must
+wait for it anyway.  ``HBBFT_TPU_NO_SHARD_PIPE=1`` restores the pre-PR-18
+behavior everywhere — single-queue SPMD sharding for every dispatch —
+with bit-identical Batches and conserved ``device_dispatches``
+(tests/test_shard_pipe.py asserts the A/B).
+
+Small-batch clamp (PR 18 satellite): ``_pad_bucket`` used to widen every
+bucket to ``lcm(bucket, n_dev)`` so the sharded axis split evenly — a
+singleton dispatch padded to 8 lanes of which 7 were padding.  Buckets
+narrower than the mesh now stay at the single-device bucket and the
+whole (sub-threshold) chunk routes to one device.
 
 Works identically on a real multi-chip slice and on the virtual
 8-device CPU mesh (tests/conftest.py) — the mesh is the only knob.
 
-Pipelining/staging composition (PR 3): MeshBackend inherits TpuBackend's
-deferred-fetch pipeline and limb-row staging cache unchanged.  The
-staging cache yields HOST numpy rows; ``_place`` (the sharded
-``device_put``) runs downstream of it, inside the same timed
-host-assembly block, so cached staging and mesh placement compose by
-construction — each pipelined chunk is already sharded before its
-dispatch is launched, and the bounded in-flight queue bounds per-chip
-pending buffers exactly as on one chip.
+Pipelining/staging composition (PR 3): the staging cache yields HOST
+numpy rows; ``_place`` (the per-device or sharded ``device_put``) runs
+downstream of it, inside the same timed host-assembly block, so cached
+staging and mesh placement compose by construction — each chunk is
+already placed before its dispatch is launched, and the per-device
+bounded queues bound per-chip pending buffers exactly as on one chip.
 
 Reference analogue: none — the reference is sans-I/O and single-process
 (SURVEY.md §2.3); this is the TPU-native replacement for the scaling the
@@ -31,31 +43,77 @@ reference delegates to its embedder.
 
 from __future__ import annotations
 
-from typing import Optional
+import math
+from typing import Any, Dict, Optional
 
+import jax
 from jax.sharding import Mesh
 
 from hbbft_tpu.ops.backend import TpuBackend, _bucket
 from hbbft_tpu.parallel.mesh import device_mesh, shard_batch
+from hbbft_tpu.parallel.shardpipe import (
+    ShardedDispatchPipeline,
+    shardpipe_enabled,
+)
 
 
 class MeshBackend(TpuBackend):
-    """TpuBackend with batch axes sharded over a device mesh."""
+    """TpuBackend scaled across a device mesh: whole pipelined chunks on
+    distinct devices (default) or batch axes sharded SPMD (sync
+    dispatches, and everything under ``HBBFT_TPU_NO_SHARD_PIPE=1``)."""
 
     def __init__(self, mesh: Optional[Mesh] = None) -> None:
         super().__init__()
         self.mesh = mesh or device_mesh()
         self._n_dev = self.mesh.devices.size
+        self._devices = list(self.mesh.devices.flat)
+        # swap the inherited single-queue pipe for the per-device one
+        # (same counters/tracer/probe contract; the tracer is attached
+        # after construction, hence the closure)
+        self._pipe = ShardedDispatchPipeline(
+            self._n_dev,
+            counters=self.counters,
+            tracer_ref=lambda: self.tracer,
+        )
 
     def _pad_bucket(self, n: int) -> int:
-        # power-of-two bucket, widened so the sharded axis splits evenly
-        # (lcm handles non-power-of-two meshes, e.g. 6 devices)
-        import math
+        # power-of-two bucket, widened so a SHARDED axis splits evenly
+        # (lcm handles non-power-of-two meshes, e.g. 6 devices) — but a
+        # bucket narrower than the mesh stays single-device-sized: a
+        # singleton dispatch padded to n_dev lanes is 7/8 padding, and
+        # _place routes such chunks whole to one device instead
+        b = _bucket(n)
+        if b < self._n_dev:
+            return b
+        return math.lcm(b, self._n_dev)
 
-        return math.lcm(_bucket(n), self._n_dev)
-
-    def _place(self, tree):
+    def _place(self, tree, pipelined: bool = False):
+        if pipelined and shardpipe_enabled():
+            # whole-chunk placement: reserve the device (recorded — the
+            # seeded replay re-derives the identical sequence), then
+            # commit the staged inputs to it; the jitted call follows
+            # its committed inputs, so chunk k runs on device d_k while
+            # chunk k+1 stages on host
+            d = self._pipe.reserve_device()
+            return jax.device_put(tree, self._devices[d])
+        leading = jax.tree_util.tree_leaves(tree)[0].shape[0]
+        if leading % self._n_dev:
+            # sub-threshold bucket (the _pad_bucket clamp): too narrow
+            # to shard evenly — the whole chunk goes to one device
+            return jax.device_put(tree, self._devices[0])
         return shard_batch(tree, self.mesh)
+
+    def shard_stats(self) -> Dict[str, Any]:
+        """Per-device dispatch tallies + cumulative imbalance (max/mean,
+        1.0 = balanced) — the heartbeat/bench observability surface."""
+        p = self._pipe
+        return {
+            "shard_devices": p.n_devices,
+            "shard_dispatches": list(p.dev_dispatches),
+            "shard_seconds": [round(s, 6) for s in p.dev_seconds],
+            "shard_imbalance": round(p.imbalance(), 4),
+            "shard_placements": len(p.placements),
+        }
 
     @property
     def name(self) -> str:

@@ -78,6 +78,19 @@ def test_slo_traffic_planned_both_modes(bench):
     assert "slo_traffic" in bench._BENCH_EST_S
 
 
+def test_mesh_scaling_planned_both_modes(bench):
+    """The per-device dispatcher structure row (PR 18) rides both
+    orderings — cheap, so under a budget it runs ahead of the traffic
+    curves and the support rows — with a cost estimate."""
+    for budget in (0.0, 3000.0):
+        names = [n for n, _ in bench._plan_benches(None, "tpu", budget)]
+        assert "mesh_scaling" in names
+    budgeted = [n for n, _ in bench._plan_benches(None, "tpu", 3000.0)]
+    assert budgeted.index("mesh_scaling") < budgeted.index("qhb_traffic")
+    assert budgeted.index("mesh_scaling") < budgeted.index("rs_encode")
+    assert "mesh_scaling" in bench._BENCH_EST_S
+
+
 def test_n100_tpu_gating(bench):
     # off-TPU driver runs never attempt the real-crypto N=100 row...
     assert "array_n100_tpu" not in [

@@ -1134,6 +1134,21 @@ def test_seam_race_catches_counter_mutant_shape():
     ]
 
 
+def test_seam_race_catches_shard_mutant_shape():
+    """The seeded ``shard`` mutant (PR 18): the resolution-order scatter
+    cursor is a submit-path write read by the per-device delivery
+    closures — mapped into scope, the crossing is flagged by name."""
+    src = (REPO_ROOT / "hbbft_tpu" / "analysis" / "mutations.py").read_text(
+        encoding="utf-8"
+    )
+    findings = lint_sources(
+        SeamRaceRule(), {"hbbft_tpu/ops/backend.py": src}
+    )
+    assert any("_scatter_cursor" in f.message for f in findings), [
+        f.render() for f in findings
+    ]
+
+
 # ---------------------------------------------------------------------------
 # byzantine-input: interprocedural upgrade (PR 9 — one call level)
 # ---------------------------------------------------------------------------

@@ -29,8 +29,15 @@ def rng():
 
 
 def test_bucket_widens_to_mesh(backend):
-    assert backend._pad_bucket(1) % 8 == 0
+    # sub-threshold batches clamp to the single-device bucket (PR 18
+    # satellite): a singleton no longer pads to 8 lanes of 7/8 waste —
+    # it stays at the 4-lane minimum bucket and routes to one device
+    assert backend._pad_bucket(1) == 4
+    assert backend._pad_bucket(4) == 4
+    # at/above the mesh width the bucket still widens to a mesh multiple
+    assert backend._pad_bucket(8) == 8
     assert backend._pad_bucket(9) == 16
+    assert backend._pad_bucket(64) % 8 == 0
     assert backend.name == "MeshBackend[8]"
 
 
@@ -99,3 +106,106 @@ def test_lane_capped_chunks_across_mesh(backend, keyset, rng):
     assert backend.counters.device_dispatches == d0 + 4
     # each chunk's 64-item bucket is a mesh multiple, so it sharded evenly
     assert backend._pad_bucket(64) % 8 == 0
+
+
+# ---------------------------------------------------------------------------
+# Per-device pipelined shard dispatch (PR 18)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("n", (63, 64, 65))
+def test_shard_killswitch_ab_at_chunk_boundaries(backend, keyset, monkeypatch, n):
+    """PR 18 acceptance A/B: the sharded per-device run and the
+    ``HBBFT_TPU_NO_SHARD_PIPE=1`` single-queue SPMD run produce
+    bit-identical shares with conserved device_dispatches — at
+    n == cap·n_dev − 1 (8 chunks, short tail), cap·n_dev (exactly the
+    mesh), and cap·n_dev + 1 (sub-threshold tail host-folded)."""
+    sks, _ = keyset
+    doc = b"shard ab doc"
+    items = [(sks.secret_key_share(i % 3), doc) for i in range(n)]
+    saved = backend.device_lane_cap, backend.device_combine_threshold
+    backend.device_lane_cap = 8  # cap·n_dev = 64
+    backend.device_combine_threshold = 2
+    try:
+        monkeypatch.delenv("HBBFT_TPU_NO_SHARD_PIPE", raising=False)
+        p0 = len(backend._pipe.placements)
+        d0 = backend.counters.device_dispatches
+        sharded = backend.sign_shares_batch(items)
+        placements = backend._pipe.placements[p0:]
+        disp_sharded = backend.counters.device_dispatches - d0
+        monkeypatch.setenv("HBBFT_TPU_NO_SHARD_PIPE", "1")
+        p1 = len(backend._pipe.placements)
+        d1 = backend.counters.device_dispatches
+        single = backend.sign_shares_batch(items)
+        disp_single = backend.counters.device_dispatches - d1
+    finally:
+        backend.device_lane_cap, backend.device_combine_threshold = saved
+    assert single == sharded  # bit-identical shares
+    assert disp_single == disp_sharded == 8  # conserved dispatch count
+    assert len(backend._pipe.placements) == p1  # killswitch: no reservations
+    # whole chunks landed round-robin on 8 consecutive distinct devices
+    assert len(placements) == 8
+    assert placements == [(placements[0] + i) % 8 for i in range(8)]
+
+
+def test_small_batch_clamps_to_single_device(backend, keyset, monkeypatch):
+    """Satellite pin: a 3-item ladder pads to the 4-lane minimum bucket
+    (1 pad lane) instead of the old lcm(bucket, n_dev) = 8 (5 pad
+    lanes), riding ONE device whole — in both A/B arms (the SPMD arm
+    routes the non-mesh-divisible chunk to a single device too)."""
+    sks, _ = keyset
+    doc = b"small batch"
+    items = [(sks.secret_key_share(i), doc) for i in range(3)]
+    golden = [sk.sign_share(d) for sk, d in items]
+    saved = backend.device_combine_threshold
+    backend.device_combine_threshold = 2
+    try:
+        monkeypatch.delenv("HBBFT_TPU_NO_SHARD_PIPE", raising=False)
+        p0 = len(backend._pipe.placements)
+        d0 = backend.counters.device_dispatches
+        assert backend.sign_shares_batch(items) == golden
+        assert backend.counters.device_dispatches == d0 + 1
+        assert len(backend._pipe.placements) == p0 + 1  # one whole chunk
+        monkeypatch.setenv("HBBFT_TPU_NO_SHARD_PIPE", "1")
+        assert backend.sign_shares_batch(items) == golden
+    finally:
+        backend.device_combine_threshold = saved
+    # pad-lane accounting: 4-lane bucket = 1 pad lane for 3 items
+    assert backend._pad_bucket(3) == 4
+
+
+def test_per_device_spans_sum_to_device_seconds(backend, keyset, tmp_path):
+    """PR 18 observability acceptance: every sharded dispatch spans its
+    device's ``device/<n>`` track, and the per-device span partition
+    sums to counters.device_seconds within ±5% (tools/trace_report.py
+    check_per_device_seconds + the report CLI)."""
+    from hbbft_tpu.obs import Tracer
+    from tools.trace_report import (
+        check_per_device_seconds,
+        load_events,
+        main as tr_main,
+        validate_chrome_trace,
+    )
+
+    sks, _ = keyset
+    items = [(sks.secret_key_share(i % 3), b"per-device") for i in range(32)]
+    backend.tracer = Tracer()
+    saved = backend.device_lane_cap, backend.device_combine_threshold
+    backend.device_lane_cap = 8  # 4 chunks on 4 distinct devices
+    backend.device_combine_threshold = 2
+    d0 = backend.counters.device_seconds
+    try:
+        assert len(backend.sign_shares_batch(items)) == 32
+    finally:
+        backend.device_lane_cap, backend.device_combine_threshold = saved
+        tr = backend.tracer
+        backend.tracer = None
+    dev = backend.counters.device_seconds - d0
+    path = str(tmp_path / "shard_trace.json")
+    tr.write(path)
+    events = load_events(path)
+    assert validate_chrome_trace(events) == []
+    ok, per = check_per_device_seconds(events, dev)
+    assert ok, (per, dev)
+    assert len([t for t in per if t.startswith("device/")]) >= 4
+    assert tr_main([path, "--device-seconds", str(dev)]) == 0

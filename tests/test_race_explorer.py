@@ -1,7 +1,8 @@
 """Schedule-space race explorer: sensitivity fixtures + replay contract.
 
-Tier-1 runs the smoke sweep (all three honest seams agree across every
-explored schedule) and pins the detector's sensitivity: each seeded
+Tier-1 runs the smoke sweep (all four honest seams — pipeline, traffic,
+virtualnet, and the PR-18 cross-shard completion order — agree across
+every explored schedule) and pins the detector's sensitivity: each seeded
 order-dependent mutant in ``analysis/mutations.py`` must be caught with
 a minimized counterexample that replays to the identical divergence in a
 fresh process (``tools/race_explorer.py --replay``).  The slow arm runs
@@ -138,6 +139,7 @@ def test_events_dependent_same_task_and_footprint():
     ("pipeline", 4, 30),
     ("traffic", 4, 20),
     ("virtualnet", 4, 40),
+    ("shard", 4, 40),
 ])
 def test_smoke_sweep_schedule_independent(target, n, max_runs):
     ex = schedules.explore(target, n, seed=0, max_runs=max_runs)

@@ -1,0 +1,219 @@
+"""Per-device pipelined shard dispatch (PR 18) — JAX-free tier-1 arm.
+
+Covers the :class:`ShardedDispatchPipeline` contract (deterministic
+placement, global-submission-order default drain, per-device FIFO with
+cross-device freedom under ``choose_shard``, per-device depth trim,
+mesh-wide sync point), the MockBackend out-of-order shard resolution
+through the engine (single-queue vs per-device A/B: bit-identical
+batches), the shard explorer target's replay determinism, and the
+heartbeat's shard-imbalance field.  The mesh-side kill-switch A/B at
+lane-cap chunk boundaries lives in tests/test_mesh_backend.py (needs
+the virtual 8-device mesh).
+"""
+
+from hbbft_tpu.analysis import schedules
+from hbbft_tpu.analysis.schedules import ShardedMockBackend
+from hbbft_tpu.obs import HealthReporter
+from hbbft_tpu.parallel.shardpipe import (
+    ShardedDispatchPipeline,
+    placement_policy,
+    shardpipe_enabled,
+)
+
+
+def _pipe(n_devices=3, depth=100):
+    return ShardedDispatchPipeline(n_devices, depth_fn=lambda: depth)
+
+
+def _submit(pipe, value, log, reserve=True):
+    if reserve:
+        pipe.reserve_device()
+    return pipe.submit(
+        lambda: value, fetch=None, kind=f"k{value}", items=1,
+        on_result=log.append,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Pipeline semantics
+# ---------------------------------------------------------------------------
+
+
+def test_round_robin_placement_is_recorded_and_cyclic():
+    pipe = _pipe(3)
+    log = []
+    for i in range(7):
+        _submit(pipe, i, log)
+    assert pipe.placements == [0, 1, 2, 0, 1, 2, 0]
+    assert pipe.dev_dispatches == [3, 2, 2]
+    assert len(pipe) == 7
+    pipe.flush()
+    assert len(pipe) == 0
+
+
+def test_default_drain_resolves_in_global_submission_order():
+    # submission order across device queues AND the base single queue —
+    # byte-compatible with the single-queue FIFO (the kill-switch A/B's
+    # delivery order)
+    pipe = _pipe(3)
+    log = []
+    for i in range(5):
+        _submit(pipe, i, log, reserve=(i != 2))  # 2 rides the base queue
+    pipe.flush()
+    assert log == [0, 1, 2, 3, 4]
+
+
+def test_choose_shard_reorders_cross_device_fifo_per_device():
+    pipe = _pipe(2)
+    log = []
+    for i in range(4):  # devices 0,1,0,1
+        _submit(pipe, i, log)
+    pipe.choose_shard = lambda ready: len(ready) - 1  # last ready first
+    pipe.flush()
+    # cross-device order flipped, per-device FIFO intact (1 before 3,
+    # 0 before 2)
+    assert log == [1, 3, 0, 2]
+
+
+def test_depth_trims_per_device_not_globally():
+    pipe = _pipe(2, depth=1)
+    log = []
+    _submit(pipe, 0, log)  # device 0
+    _submit(pipe, 1, log)  # device 1 — its own queue, no trim of dev 0
+    assert log == []
+    _submit(pipe, 2, log)  # device 0 again: trims entry 0
+    assert log == [0]
+    pipe.flush()
+    assert log == [0, 1, 2]
+
+
+def test_sync_submit_drains_every_queue_in_program_order():
+    pipe = _pipe(3)
+    log = []
+    for i in range(3):
+        _submit(pipe, i, log)
+    pipe.choose_shard = lambda ready: len(ready) - 1  # must NOT apply
+    p = pipe.submit(lambda: "sync", fetch=None, on_result=log.append,
+                    sync=True)
+    assert p.done
+    assert log == [0, 1, 2, "sync"]  # mesh-wide single sync point
+
+
+def test_killswitch_and_placement_policy_env(monkeypatch):
+    monkeypatch.delenv("HBBFT_TPU_NO_SHARD_PIPE", raising=False)
+    assert shardpipe_enabled()
+    monkeypatch.setenv("HBBFT_TPU_NO_SHARD_PIPE", "1")
+    assert not shardpipe_enabled()
+    monkeypatch.delenv("HBBFT_TPU_SHARD_PLACEMENT", raising=False)
+    assert placement_policy() == "round_robin"
+    monkeypatch.setenv("HBBFT_TPU_SHARD_PLACEMENT", "least_loaded")
+    assert placement_policy() == "least_loaded"
+    monkeypatch.setenv("HBBFT_TPU_SHARD_PLACEMENT", "bogus")
+    assert placement_policy() == "round_robin"  # fall back, don't raise
+
+
+def test_least_loaded_placement_reads_queue_depths(monkeypatch):
+    monkeypatch.setenv("HBBFT_TPU_SHARD_PLACEMENT", "least_loaded")
+    pipe = _pipe(3)
+    log = []
+    for i in range(4):
+        _submit(pipe, i, log)
+    # empty queues tie to the lowest index, then depths equalize
+    assert pipe.placements == [0, 1, 2, 0]
+    pipe.flush()
+    _submit(pipe, 9, log)
+    assert pipe.placements[-1] == 0  # drained: all empty again
+
+
+def test_imbalance_is_max_over_mean():
+    pipe = _pipe(2)
+    log = []
+    for i in range(3):  # devices 0,1,0 → [2,1]
+        _submit(pipe, i, log)
+    assert abs(pipe.imbalance() - (2 / 1.5)) < 1e-9
+    assert _pipe(2).imbalance() == 0.0  # no dispatches yet
+
+
+# ---------------------------------------------------------------------------
+# MockBackend out-of-order shard resolution (the tier-1 engine A/B)
+# ---------------------------------------------------------------------------
+
+
+def test_sharded_mock_delivers_out_of_submission_order():
+    backend = ShardedMockBackend()
+    backend.pipeline_chunk = 2
+    order = []
+    backend.chunk_listeners = (lambda lo, res: order.append(lo),)
+    out, finish = backend._piped_submit(
+        list(range(10)), lambda chunk: [x * 2 for x in chunk]
+    )
+    assert finish() is out
+    # chunks at offsets 0,2,4,6 landed on devices 0..3 and offset 8 on
+    # device 0; the default last-ready-first hook resolves cross-device
+    # out of submission order while device 0 stays FIFO (0 before 8)
+    assert order == [6, 4, 2, 0, 8]
+    assert out == [x * 2 for x in range(10)]  # slot-disjoint: unharmed
+    assert backend._pipe.placements == [0, 1, 2, 3, 0]
+
+
+def test_engine_batches_bit_identical_single_queue_vs_sharded():
+    """The conserved-output A/B at the engine level: the same seeded run
+    through the single-queue MockBackend pipeline and through the
+    per-device sharded pipeline (cross-device out-of-order) must commit
+    bit-identical batches with identical fault logs and counters."""
+    a = schedules.run_schedule("pipeline", 4, 11, [])
+    b = schedules.run_schedule("shard", 4, 11, [])
+    assert a.parts["batches_sha"] == b.parts["batches_sha"]
+    assert a.parts["faults"] == b.parts["faults"]
+    assert a.parts["counters"] == b.parts["counters"]
+    assert a.parts["error"] == b.parts["error"] == ""
+    # the sharded run really did spread whole chunks across devices
+    assert len([d for d in b.parts["extra"]["dev_dispatches"] if d]) > 1
+
+
+def test_shard_target_replay_is_deterministic():
+    a = schedules.run_schedule("shard", 4, 5, [1, 0, 2])
+    b = schedules.run_schedule("shard", 4, 5, [1, 0, 2])
+    assert a.parts == b.parts
+    assert a.parts["extra"]["placements_sha"] == \
+        b.parts["extra"]["placements_sha"]
+    assert a.canonical == b.canonical
+
+
+def test_shard_tracker_orders_same_device_queue_entries():
+    """RaceTracker devq footprints: same-device submit→resolve edges are
+    ordered; cross-device resolves on the same batch surface as racing."""
+    r = schedules.run_schedule("shard", 4, 0, [])
+    devq = [e for e in r.events if any(k == "devq" for k, _ in e.writes)]
+    assert devq, "no per-device-queue footprints recorded"
+    kinds = {e.key.split(":", 1)[0] for e in devq}
+    assert kinds == {"submit", "resolve"}
+
+
+# ---------------------------------------------------------------------------
+# Heartbeat field
+# ---------------------------------------------------------------------------
+
+
+def test_heartbeat_carries_shard_imbalance():
+    beats = []
+    hr = HealthReporter(
+        interval_s=0.0,
+        sink=beats.append,
+        shard_stats_fn=lambda: {
+            "shard_imbalance": 1.25,
+            "shard_dispatches": [3, 1],
+            "shard_devices": 2,
+        },
+    )
+    rec = hr.tick(epoch=1, msgs=10.0)
+    assert rec is not None
+    assert rec["shard_imbalance"] == 1.25
+    assert rec["shard_dispatches"] == [3, 1]
+    # the hook must never break a heartbeat
+    hr_bad = HealthReporter(
+        interval_s=0.0, sink=beats.append,
+        shard_stats_fn=lambda: (_ for _ in ()).throw(RuntimeError("boom")),
+    )
+    rec2 = hr_bad.tick(epoch=2, msgs=20.0)
+    assert rec2 is not None and "shard_imbalance" not in rec2

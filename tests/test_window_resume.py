@@ -20,7 +20,7 @@ _SCRIPT = os.path.join(_REPO, "tools", "tpu_window.sh")
 _ALL_STEPS = [
     "n100", "matrix_rns_a", "matrix_limb_a", "matrix_rns_b", "matrix_limb_b",
     "glv_ab", "host_ab", "adv_matrix", "qhb_traffic", "slo_traffic",
-    "crash_matrix", "n16_churn", "flips10k", "kernel_levers",
+    "crash_matrix", "mesh_scaling", "n16_churn", "flips10k", "kernel_levers",
     "driver_budget", "rs_ab", "n32_churn", "n64coin", "n100_churn",
 ]
 

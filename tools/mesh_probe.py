@@ -1,0 +1,98 @@
+"""One virtual-CPU-mesh probe for bench.py's ``mesh_scaling`` row (PR 18).
+
+Runs in a fresh subprocess so the XLA host-platform device count can be
+forced per mesh size (it is fixed at JAX init).  Drives the per-device
+pipelined dispatcher (parallel/shardpipe.py) with ``BENCH_MESH_CHUNKS``
+committed-placement dispatches of a trivial jitted reduction and prints
+one JSON line of structural facts: placement determinism, balanced
+per-device dispatch tallies, imbalance, and chunk throughput.  The
+throughput number is STRUCTURAL ONLY — host-platform "devices" share the
+physical cores, so it must never be read as a scale-out measurement
+(PERF.md round 14); the real-mesh number comes from the window runbook.
+
+Env: BENCH_MESH_DEVICES (mesh size), BENCH_MESH_CHUNKS (default 64),
+BENCH_MESH_LANES (elements per chunk, default 4096).
+"""
+
+import json
+import os
+import sys
+import time
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+
+def main() -> int:
+    k = int(os.environ.get("BENCH_MESH_DEVICES", "1"))
+    chunks = int(os.environ.get("BENCH_MESH_CHUNKS", "64"))
+    lanes = int(os.environ.get("BENCH_MESH_LANES", "4096"))
+    native = os.environ.get("BENCH_MESH_PLATFORM") == "native"
+    if not native:
+        # the device count must be pinned before JAX initializes; drop
+        # any inherited pin (e.g. the test conftest's 8) so ours wins
+        flags = [
+            f
+            for f in os.environ.get("XLA_FLAGS", "").split()
+            if "xla_force_host_platform_device_count" not in f
+        ]
+        flags.append(f"--xla_force_host_platform_device_count={k}")
+        os.environ["XLA_FLAGS"] = " ".join(flags)
+        os.environ["JAX_PLATFORMS"] = "cpu"  # virtual mesh = CPU
+
+    import jax
+    import jax.numpy as jnp
+
+    from hbbft_tpu.parallel.shardpipe import ShardedDispatchPipeline
+
+    devices = jax.devices()[:k]
+    if len(devices) != k:
+        print(json.dumps({"error": f"got {len(devices)} devices, want {k}"}))
+        return 1
+    fn = jax.jit(lambda x: (x * 2.0 + 1.0).sum())
+    base = jnp.arange(lanes, dtype=jnp.float32)
+    for d in devices:  # compile + warm every device before timing
+        fn(jax.device_put(base, d)).block_until_ready()
+
+    pipe = ShardedDispatchPipeline(k)
+    out = []
+    t0 = time.perf_counter()
+    for i in range(chunks):
+        d = pipe.reserve_device()
+        staged = jax.device_put(base, devices[d])
+        pipe.submit(
+            lambda staged=staged: fn(staged),
+            fetch=float,
+            kind=f"c{i}",
+            items=lanes,
+            on_result=out.append,
+        )
+    pipe.flush()
+    dt = time.perf_counter() - t0
+
+    expect = float(fn(base))
+    print(
+        json.dumps(
+            {
+                "devices": k,
+                "chunks": chunks,
+                "chunks_per_s": round(chunks / dt, 2),
+                "wall_s": round(dt, 4),
+                "dev_dispatches": pipe.dev_dispatches,
+                "placements_ok": pipe.placements
+                == [i % k for i in range(chunks)],
+                "balanced": max(pipe.dev_dispatches)
+                - min(pipe.dev_dispatches)
+                <= (1 if chunks % k else 0),
+                "imbalance": round(pipe.imbalance(), 4),
+                "results_ok": len(out) == chunks
+                and all(abs(v - expect) < 1e-3 * abs(expect) for v in out),
+                "platform": devices[0].platform,
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

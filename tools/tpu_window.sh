@@ -294,6 +294,18 @@ do_crash_matrix() {
     BENCH_CRASH_N=5 BENCH_CRASH_EPOCHS=8 \
     timeout 3600 python bench.py
 }
+done_mesh_scaling() {
+  has_row "$ART/rows_after_mesh_scaling.json" mesh_scaling
+}
+do_mesh_scaling() {
+  # per-device pipelined dispatch (PR 18) ON THE REAL MESH: the only
+  # capture where mesh_scaling's chunks/s is a scale-out number — the
+  # driver bench's virtual-CPU row is structural (devices share cores).
+  # native mode sizes the sweep by what the chip actually exposes.
+  BENCH_ONLY=mesh_scaling BENCH_MESH_PLATFORM=native \
+    BENCH_MESH_SIZES=1,2,4,8 BENCH_MESH_CHUNKS=256 \
+    timeout 1800 python bench.py
+}
 done_n32_churn() {
   has_row "$ART/rows_after_n32_churn.json" array_epochs_per_sec_n100 \
     backend=TpuBackend n=32
@@ -336,7 +348,7 @@ do_n100_churn() {
     timeout 18000 python bench.py
 }
 
-STEPS="n100 matrix_rns_a matrix_limb_a matrix_rns_b matrix_limb_b glv_ab host_ab adv_matrix qhb_traffic slo_traffic crash_matrix n16_churn flips10k kernel_levers driver_budget rs_ab n32_churn n64coin n100_churn"
+STEPS="n100 matrix_rns_a matrix_limb_a matrix_rns_b matrix_limb_b glv_ab host_ab adv_matrix qhb_traffic slo_traffic crash_matrix mesh_scaling n16_churn flips10k kernel_levers driver_budget rs_ab n32_churn n64coin n100_churn"
 
 for s in $STEPS; do
   if "done_$s"; then

@@ -202,6 +202,48 @@ def check_device_seconds(
     return (abs(got - expected) <= tol * expected, got)
 
 
+def track_name_map(events: List[Dict[str, Any]]) -> Dict[Any, str]:
+    """tid -> tracer track name, from the ``thread_name`` metadata
+    events obs/tracer.py emits for every track."""
+    out: Dict[Any, str] = {}
+    for ev in events:
+        if ev.get("ph") == "M" and ev.get("name") == "thread_name":
+            out[ev.get("tid")] = (ev.get("args") or {}).get("name", "")
+    return out
+
+
+def per_device_span_seconds(events: List[Dict[str, Any]]) -> Dict[str, float]:
+    """``device=True`` span seconds grouped by tracer track — the shard
+    pipeline's per-device attribution surface (parallel/shardpipe.py).
+
+    Sharded dispatches span ``device/<n>`` (their device's track);
+    unreserved async entries span ``device/q<slot>`` and sync entries
+    the classic ``device`` track, so the values sum to the SAME total as
+    :func:`device_span_seconds` however the run was routed."""
+    names = track_name_map(events)
+    out: Dict[str, float] = {}
+    for s in span_durations(events):
+        if not s["device"]:
+            continue
+        track = names.get(s["tid"], str(s["tid"]))
+        out[track] = out.get(track, 0.0) + s["dur_us"] / 1e6
+    return out
+
+
+def check_per_device_seconds(
+    events: List[Dict[str, Any]], expected: float, tol: float = 0.05
+) -> Tuple[bool, Dict[str, float]]:
+    """Acceptance check (PR 18): the per-device span partition must sum
+    to ``expected`` (counters.device_seconds) within ``tol`` relative —
+    per-device attribution may not lose or double-bill device time
+    relative to the global counter.  Returns (ok, per-track seconds)."""
+    per = per_device_span_seconds(events)
+    got = sum(per.values())
+    if expected <= 0:
+        return (got == 0.0, per)
+    return (abs(got - expected) <= tol * expected, per)
+
+
 def host_bucket_seconds(events: List[Dict[str, Any]]) -> Dict[str, float]:
     """Per-bucket host seconds from the ``host=True`` region spans.
 
@@ -302,12 +344,26 @@ def report(
             f"{r['cat']:>12} {where:>7} {r['count']:>8} "
             f"{r['seconds']:>10.4f} {r['share']:>6.1%}"
         )
+    per = per_device_span_seconds(events)
+    if any(t.startswith("device/") for t in per):
+        print(f"{'device track':>14} {'seconds':>10}")
+        for track in sorted(per):
+            print(f"{track:>14} {per[track]:>10.4f}")
     if device_seconds is not None:
         ok, got = check_device_seconds(events, device_seconds, tol)
         verdict = "OK" if ok else "MISMATCH"
         print(
             f"device-seconds check: spans {got:.4f} s vs counter "
             f"{device_seconds:.4f} s (±{tol:.0%}) — {verdict}"
+        )
+        if not ok:
+            return 1
+        ok, per = check_per_device_seconds(events, device_seconds, tol)
+        verdict = "OK" if ok else "MISMATCH"
+        print(
+            f"per-device check: {len(per)} track(s) sum "
+            f"{sum(per.values()):.4f} s vs counter {device_seconds:.4f} s "
+            f"(±{tol:.0%}) — {verdict}"
         )
         if not ok:
             return 1
