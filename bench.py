@@ -1388,6 +1388,108 @@ def bench_rs_host() -> dict:
     }
 
 
+def bench_rs_plane_ab() -> dict:
+    """Device erasure/hash plane vs host codec A/B (``rs_plane_ab``):
+    the full per-proposer RS+Merkle workload — batched encode, tree
+    build, all-N² proof verifies, and an m-erasure reconstruct — through
+    ``TpuBackend``'s plane methods, at the N=16 shape (k=6, m=10) and
+    the N=100 f=33 broadcast shape (k=34, m=66).  In-process A/B:
+    HBBFT_TPU_NO_DEVICE_RS is read per call, so the host arm runs the
+    byte-for-byte protocol codec path through the SAME entry points.
+    Fresh random blocks per timed iteration (fresh-buffer discipline),
+    golden spot-checks against the host codec + hashlib trees in BOTH
+    arms, and kill-switch non-leak asserts in both directions via the
+    rs_enc/merkle dispatch-kind counters."""
+    import random
+
+    from hbbft_tpu.crypto.erasure import RSCodec
+    from hbbft_tpu.crypto.merkle import MerkleTree, PackedProofs
+    from hbbft_tpu.ops.backend import TpuBackend
+
+    shapes = [("n16", 6, 10), ("n100_f33", 34, 66)]
+    block = _env_int("BENCH_RS_PLANE_BLOCK", 4096)
+    iters = max(1, _env_int("BENCH_RS_PLANE_ITERS", 3))
+
+    def arm(no_device: bool) -> dict:
+        saved = os.environ.pop("HBBFT_TPU_NO_DEVICE_RS", None)
+        if no_device:
+            os.environ["HBBFT_TPU_NO_DEVICE_RS"] = "1"
+        try:
+            rng = random.Random(419)
+            be = TpuBackend()
+            out: dict = {}
+
+            def workload(codec, n, datas):
+                sh = be.rs_encode_batch(codec, datas)
+                trees = be.merkle_build_batch(sh)
+                packed = PackedProofs.from_trees(trees, n, device=not no_device)
+                verdicts = (
+                    be.merkle_verify_batch(packed)
+                    if packed is not None
+                    else [
+                        t.proof(i).validate(n)
+                        for t in trees
+                        for i in range(n)
+                    ]
+                )
+                holes = [list(s) for s in sh]
+                for h in holes:
+                    for j in rng.sample(range(n), codec.m):
+                        h[j] = None
+                rec = be.rs_reconstruct_batch(codec, holes)
+                return sh, trees, verdicts, rec
+
+            for label, k, m in shapes:
+                codec = RSCodec(k, m)
+                n = k + m
+                datas = [
+                    rng.randbytes(block) for _ in range(n)
+                ]
+                workload(codec, n, datas)  # compile + warm
+                t0 = time.perf_counter()
+                for _ in range(iters):
+                    datas = [rng.randbytes(block) for _ in range(n)]
+                    sh, trees, verdicts, rec = workload(codec, n, datas)
+                dt = time.perf_counter() - t0
+                # golden spot check, last iteration, both arms
+                i = rng.randrange(n)
+                assert sh[i] == codec.encode(datas[i]), "A/B arm encode wrong"
+                assert (
+                    trees[i].root_hash == MerkleTree(sh[i]).root_hash
+                ), "A/B arm tree wrong"
+                assert all(verdicts), "A/B arm verify wrong"
+                assert rec[i] == sh[i], "A/B arm reconstruct wrong"
+                out[label] = iters * n / dt
+            c = be.counters
+            out["plane_seconds"] = (
+                c.device_seconds_rs_enc
+                + c.device_seconds_rs_dec
+                + c.device_seconds_merkle
+            )
+            return out
+        finally:
+            if saved is None:
+                os.environ.pop("HBBFT_TPU_NO_DEVICE_RS", None)
+            else:
+                os.environ["HBBFT_TPU_NO_DEVICE_RS"] = saved
+
+    dev = arm(no_device=False)
+    host = arm(no_device=True)
+    assert dev["plane_seconds"] > 0, "device arm never dispatched — vacuous A/B"
+    assert host["plane_seconds"] == 0, "kill switch leaked into the host arm"
+    return {
+        "metric": "rs_plane_ab",
+        "value": round(dev["n100_f33"], 2),
+        "unit": "blocks/s",
+        "batch": block,
+        "host_blocks_per_sec": round(host["n100_f33"], 2),
+        "device_vs_host": round(dev["n100_f33"] / host["n100_f33"], 3),
+        "n16_blocks_per_sec": round(dev["n16"], 2),
+        "n16_host_blocks_per_sec": round(host["n16"], 2),
+        "n16_device_vs_host": round(dev["n16"] / host["n16"], 3),
+    }
+
+
 def bench_epochs_n100() -> dict:
     """North-star macro shape: N=100 f=33 QHB epochs/sec, end to end.
 
@@ -1586,6 +1688,10 @@ def _bench_array_engine(
         "device_seconds_decrypt": 0.0,
         "device_seconds_dkg": 0.0,
         "device_seconds_encrypt": 0.0,
+        # device erasure/hash plane (PR 19)
+        "device_seconds_rs_enc": 0.0,
+        "device_seconds_rs_dec": 0.0,
+        "device_seconds_merkle": 0.0,
     }
     # mid-run only: era changes need a preceding and a following epoch, so
     # indices clamp to [1, epochs-1] and dedupe (epochs < 2 → no churn; the
@@ -2177,6 +2283,7 @@ _BENCH_EST_S = {
     "rlc_dec": 180, "share_verify": 150, "rlc_sig": 150, "g2_sign": 150,
     "coin_e2e": 240, "rlc_dec_adversarial": 150, "array_n16_tpu": 420,
     "array_n100_tpu": 1200, "rs_encode": 120, "rs_host": 60,
+    "rs_plane_ab": 180,
     "fq_kernel": 240, "n4": 60, "n4_realcrypto": 300, "n100": 420,
     "array_n256_soak": 300, "array_n100_dedup": 120, "array_n64_coin": 240,
     "array_n100": 300, "glv_ladder": 180, "adv_matrix": 600,
@@ -2230,7 +2337,11 @@ def _plan_benches(only, platform: str, budget: float) -> list:
         plan.append(("qhb_traffic", bench_qhb_traffic))
         # control plane: the adaptive-vs-fixed-B SLO row rides with it
         plan.append(("slo_traffic", bench_slo_traffic))
-        plan += [("rs_encode", bench_rs_encode), ("rs_host", bench_rs_host)]
+        plan += [
+            ("rs_encode", bench_rs_encode),
+            ("rs_host", bench_rs_host),
+            ("rs_plane_ab", bench_rs_plane_ab),
+        ]
         if fqk:
             plan.append(("fq_kernel", bench_fq_kernel))
         if n4:
@@ -2250,6 +2361,7 @@ def _plan_benches(only, platform: str, budget: float) -> list:
         plan = [
             ("rs_encode", bench_rs_encode),
             ("rs_host", bench_rs_host),
+            ("rs_plane_ab", bench_rs_plane_ab),
             ("share_verify", bench_share_verify),
         ]
         if n4:

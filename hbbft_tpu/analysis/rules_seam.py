@@ -191,6 +191,11 @@ class SeamRaceRule(Rule):
         # (batch_size_provider / Observation), not ambient self attrs
         "hbbft_tpu/traffic/driver.py",
         "hbbft_tpu/control/",
+        # PR 19: the device erasure/hash plane — its delivery callbacks
+        # (rs_enc/rs_dec/merkle dispatch kinds) must keep state in
+        # closure locals, never ambient self attrs
+        "hbbft_tpu/ops/gf256.py",
+        "hbbft_tpu/ops/sha256.py",
     )
 
     def check_module(self, mod: ModuleSource) -> List[Finding]:

@@ -266,6 +266,11 @@ class DeferredFetchRule(Rule):
         # there re-serializes the overlap exactly like one in the engine
         "hbbft_tpu/traffic/driver.py",
         "hbbft_tpu/net/scenarios.py",
+        # PR 19: the device erasure/hash plane kernels — their results
+        # must flow back through the pipeline seam like every other
+        # dispatch kind, so a stray fetch here is the same regression
+        "hbbft_tpu/ops/gf256.py",
+        "hbbft_tpu/ops/sha256.py",
     )
 
     def check_module(self, mod: ModuleSource) -> List[Finding]:

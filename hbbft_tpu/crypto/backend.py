@@ -45,6 +45,11 @@ from hbbft_tpu.crypto.keys import (
 class CryptoBackend(abc.ABC):
     """Factory + batched crypto operations over one group backend."""
 
+    #: True on backends whose erasure/hash plane runs on the device
+    #: (TpuBackend): the engine uses it to decide whether PackedProofs
+    #: may skip the native-SHA gate (crypto/merkle.py from_trees).
+    device_rs_plane: bool = False
+
     def __init__(self, group: Group) -> None:
         self.group = group
         from hbbft_tpu.obs.hostbuckets import HostBuckets
@@ -293,6 +298,53 @@ class CryptoBackend(abc.ABC):
         for el in self.g1_mul_batch(scalars, points):
             acc = g.g1_add(acc, el)
         return acc
+
+    # -- erasure/hash plane (PR 19) ------------------------------------------
+    #
+    # The RBC plane's RS encode/reconstruct and Merkle build/verify, batched
+    # across proposers exactly like the crypto entry points batch across
+    # shares.  Defaults are the host codec/hashlib loops (bit-identical to
+    # calling the codec / MerkleTree directly); TpuBackend overrides route
+    # them through the GF(2⁸) bit-matmul + device SHA-256 dispatches behind
+    # the same DispatchPipeline seam (ops/backend.py).
+
+    def rs_encode_batch(
+        self, codec, datas: Sequence[bytes]
+    ) -> List[List[bytes]]:
+        """RS-encode many data blocks with one codec: per block, k data
+        shards + m parity shards (``RSCodec.encode`` semantics)."""
+        return self._traced(
+            "rs_enc", len(datas), lambda: [codec.encode(d) for d in datas]
+        )
+
+    def rs_reconstruct_batch(
+        self, codec, shard_lists: Sequence[Sequence[Optional[bytes]]]
+    ) -> List[List[bytes]]:
+        """Reconstruct many shard vectors (``RSCodec.reconstruct``
+        semantics, including its error raises and the zero-math
+        all-present fast case)."""
+        return self._traced(
+            "rs_dec",
+            len(shard_lists),
+            lambda: [codec.reconstruct(list(s)) for s in shard_lists],
+        )
+
+    def merkle_build_batch(self, shard_lists: Sequence[Sequence[bytes]]) -> List[Any]:
+        """Build one MerkleTree per shard vector."""
+        from hbbft_tpu.crypto.merkle import MerkleTree
+
+        return self._traced(
+            "merkle",
+            len(shard_lists),
+            lambda: [MerkleTree(list(sl)) for sl in shard_lists],
+        )
+
+    def merkle_verify_batch(self, packed, reps: int = 1) -> List[bool]:
+        """Validate a ``PackedProofs`` batch (``reps`` repetitions keep the
+        measured hash workload equal to N independent receivers)."""
+        return self._traced(
+            "merkle", len(packed), lambda: packed.validate(reps)
+        )
 
     # -- misc ----------------------------------------------------------------
 

@@ -165,10 +165,17 @@ class RSCodec:
         """Hook: values at points ``xs`` (k×L) → values at ``missing``."""
         return _GF.matmul(_GF.lagrange_matrix(list(xs), list(missing)), stack)
 
+    def shard_length(self, data_len: int) -> int:
+        """Shard byte-length for a ``data_len``-byte block (1 for empty —
+        encode always emits non-empty shards).  Shared framing contract
+        with the batched device plane (ops/backend.py groups encodes by
+        this value so equal-length blocks collapse into one matmul)."""
+        return -(-data_len // self.k) if data_len else 1
+
     def encode(self, data: bytes) -> List[bytes]:
         """Split ``data`` into k shards (zero-padded after a length prefix is
         the caller's concern) and append m parity shards."""
-        shard_len = -(-len(data) // self.k) if data else 1
+        shard_len = self.shard_length(len(data))
         padded = data.ljust(shard_len * self.k, b"\0")
         mat = np.frombuffer(padded, dtype=np.uint8).reshape(self.k, shard_len)
         parity = self._parity(mat)

@@ -110,6 +110,20 @@ class MerkleTree:
             ]
             self.levels.append(level)
 
+    @classmethod
+    def from_levels(
+        cls, leaves: Sequence[bytes], levels: Sequence[Sequence[bytes]]
+    ) -> "MerkleTree":
+        """Adopt already-computed hash levels without re-hashing — the
+        device erasure/hash plane (ops/backend.py merkle_build_batch)
+        hashes all trees in one batched SHA-256 dispatch and hands the
+        fetched levels here.  Callers guarantee ``levels`` is exactly
+        what ``__init__`` would have computed for ``leaves``."""
+        t = cls.__new__(cls)
+        t.leaves = list(leaves)
+        t.levels = [list(lvl) for lvl in levels]
+        return t
+
     @property
     def root_hash(self) -> bytes:
         return self.levels[-1][0]
@@ -156,20 +170,26 @@ class PackedProofs:
 
     @classmethod
     def from_trees(
-        cls, trees: Sequence["MerkleTree"], n_leaves: int
+        cls, trees: Sequence["MerkleTree"], n_leaves: int, device: bool = False
     ) -> Optional["PackedProofs"]:
         """Pack all proofs of ``trees`` (each with ``n_leaves`` real
         leaves of one uniform length).  Returns None when the native
         SHA kernel is unavailable or the shapes don't fit its limits —
-        callers fall back to per-proof objects."""
+        callers fall back to per-proof objects.  ``device=True`` skips
+        the native-kernel gate and its leaf-size cap: the packed form is
+        then destined for the batched device SHA-256 verify
+        (ops/backend.py merkle_verify_batch), which has neither limit;
+        uniformity checks still apply (the device walk needs rectangles)."""
         import numpy as np
 
         from hbbft_tpu import native
 
-        if not trees or not native.sha256_available():
+        if not trees:
+            return None
+        if not device and not native.sha256_available():
             return None
         leaf_len = len(trees[0].leaves[0])
-        if leaf_len + 1 > 4096:
+        if not device and leaf_len + 1 > 4096:
             return None
         for t in trees:
             if len(t.leaves) != n_leaves or any(

@@ -73,7 +73,7 @@ from hbbft_tpu.crypto.backend import CryptoBackend, MockBackend
 from hbbft_tpu.crypto.erasure import rs_codec
 from hbbft_tpu.crypto.merkle import MerkleTree, PackedProofs, _depth, validate_proofs
 from hbbft_tpu.obs import critpath as _critpath
-from hbbft_tpu.ops.pipeline import hostpipe_enabled
+from hbbft_tpu.ops.pipeline import device_rs_enabled, hostpipe_enabled
 from hbbft_tpu.protocols.honey_badger import Batch
 from hbbft_tpu.utils import canonical
 from hbbft_tpu.utils.metrics import Counters
@@ -370,12 +370,28 @@ class ArrayHoneyBadgerNet:
         trees: Dict[Any, MerkleTree] = {}
         shards: Dict[Any, List[bytes]] = {}
         with bk.region("rs_merkle"):
-            for nid in self.ids:
-                framed = len(ct_bytes[nid]).to_bytes(4, "big") + ct_bytes[nid]
-                sh = self.codec.encode(framed)
-                shards[nid] = sh
-                trees[nid] = MerkleTree(sh)
-                rep.rs_encodes += 1
+            framed_list = [
+                len(ct_bytes[nid]).to_bytes(4, "big") + ct_bytes[nid]
+                for nid in self.ids
+            ]
+            if fast:
+                # erasure/hash plane behind the backend seam (PR 19): on
+                # TpuBackend the N encodes collapse into one batched
+                # GF(2⁸) bit-matmul and the N tree builds into one
+                # batched device SHA-256 dispatch; host backends run the
+                # identical per-item loops behind the batch entry points
+                sh_lists = self.backend.rs_encode_batch(self.codec, framed_list)
+                tree_list = self.backend.merkle_build_batch(sh_lists)
+                for nid, sh, t in zip(self.ids, sh_lists, tree_list):
+                    shards[nid] = sh
+                    trees[nid] = t
+                    rep.rs_encodes += 1
+            else:
+                for nid, framed in zip(self.ids, framed_list):
+                    sh = self.codec.encode(framed)
+                    shards[nid] = sh
+                    trees[nid] = MerkleTree(sh)
+                    rep.rs_encodes += 1
         tree_size = 1 << _depth(n)  # trees pad to a power of two
         rep.hashes += n * (2 * tree_size - 1)
         self._count_msgs(rep, n * (n - 1))  # Value: point-to-point
@@ -391,8 +407,12 @@ class ArrayHoneyBadgerNet:
         packed: Optional[PackedProofs] = None
         with bk.region("rs_merkle"):
             if fast:
+                # device=True lifts the native-SHA gate when the packed
+                # batch is headed for the device proof walk instead of
+                # the C kernel — the kill-switch arm keeps today's choice
                 packed = PackedProofs.from_trees(
-                    [trees[p] for p in self.ids], n
+                    [trees[p] for p in self.ids], n,
+                    device=self.backend.device_rs_plane and device_rs_enabled(),
                 )
             if packed is None:
                 proofs = [trees[p].proof(s) for p in self.ids for s in range(n)]
@@ -400,7 +420,7 @@ class ArrayHoneyBadgerNet:
 
         def _validate_all(reps: int) -> List[bool]:
             if packed is not None:
-                return packed.validate(reps=reps)
+                return self.backend.merkle_verify_batch(packed, reps=reps)
             return validate_proofs(proofs, n, reps=reps)
 
         # ------ round 1: validate own Value proof, send Echo ---------------
@@ -436,15 +456,21 @@ class ArrayHoneyBadgerNet:
         reps = 1 if self.dedup_verifies else n
         full_shards: Dict[Any, List[bytes]] = {}
         with bk.region("rs_merkle"):
-            for p in self.ids:
-                if fast:
-                    # every receiver performs this identical all-present
-                    # reconstruction — ONE pass through the (native GFNI)
-                    # codec, replicated in ACCOUNTING only
-                    full = self.codec.reconstruct(list(shards[p]))
-                else:
+            if fast:
+                # every receiver performs this identical all-present
+                # reconstruction — ONE batched pass through the backend
+                # plane (the all-present case is zero GF math on every
+                # backend), replicated in ACCOUNTING only
+                full_list = self.backend.rs_reconstruct_batch(
+                    self.codec, [list(shards[p]) for p in self.ids]
+                )
+            else:
+                full_list = []
+                for p in self.ids:
                     for _ in range(reps):
                         full = self.codec.reconstruct(list(shards[p]))
+                    full_list.append(full)
+            for p, full in zip(self.ids, full_list):
                 full_shards[p] = full
                 framed = b"".join(full[: self.codec.k])
                 length = int.from_bytes(framed[:4], "big")

@@ -63,9 +63,13 @@ from hbbft_tpu.crypto.keys import (
     Signature,
     SignatureShare,
 )
+from hbbft_tpu.crypto.merkle import MerkleTree
 from hbbft_tpu.ops import curve, pairing, tower
+from hbbft_tpu.ops import sha256 as sha256_device
+from hbbft_tpu.ops.gf256 import DecodeMatrixCache, expand_gf_matrix, gf256_matmul
 from hbbft_tpu.ops.pipeline import (
     DispatchPipeline,
+    device_rs_enabled,
     fetch_to_host,
     hostpipe_enabled,
 )
@@ -194,6 +198,10 @@ class TpuBackend(CryptoBackend):
     verify/combine paths move to the device.
     """
 
+    #: the erasure/hash plane (RS bit-matmuls + device SHA-256 Merkle)
+    #: rides the dispatch seam here (see the plane methods at the end of
+    #: the class); crypto/merkle.py's PackedProofs packing keys off this
+    device_rs_plane = True
     #: combine on device only when at least this many shares are batched
     device_combine_threshold = 8
     #: Max ladder lanes (items × shares) per combine/generation dispatch.
@@ -218,6 +226,12 @@ class TpuBackend(CryptoBackend):
         # see _rlc_adaptive_cap.  Plain floats, no entropy.
         self._rlc_obs_items = 0.0
         self._rlc_obs_rejects = 0.0
+        # Device erasure/hash plane constants: per-(k, m) bit-expanded
+        # encode matrices (a handful of codec shapes per process) and the
+        # erasure-pattern-keyed LRU of decode matrices (bounded — distinct
+        # patterns are combinatorially many; see ops/gf256.py).
+        self._rs_enc_bits: Dict[Tuple[int, int], Any] = {}
+        self._rs_dec_cache = DecodeMatrixCache()
         # Lagrange-combine prep memo: the engine's N² combines per epoch
         # all interpolate over the SAME share indices (the lowest f+1),
         # and the (bits, negs) ladder form is a pure function of those
@@ -1514,4 +1528,210 @@ class TpuBackend(CryptoBackend):
             curve.prep_g2_scalars,
             kind=kind,
         )
+
+    # -- device erasure/hash plane (PR 19) -----------------------------------
+    #
+    # RS encode/reconstruct as GF(2⁸) F₂ bit-matmuls (ops/gf256.py) and
+    # Merkle build/verify as batched device SHA-256 (ops/sha256.py), routed
+    # through the same DispatchPipeline seam as the pairing/ladder chunks —
+    # _place(pipelined=True) means these chunks also ride MeshBackend's
+    # per-device queues (parallel/shardpipe.py) with no extra code.  Every
+    # method is bit-identical to the CryptoBackend host default (asserted
+    # by the parity fuzz in tests/test_device_rs.py), and
+    # HBBFT_TPU_NO_DEVICE_RS=1 routes straight to it.  The explicit
+    # CryptoBackend.<method>(self, ...) calls (instead of super()) keep the
+    # fallback usable from test hybrids that borrow these methods unbound.
+
+    def rs_encode_batch(
+        self, codec, datas: Sequence[bytes]
+    ) -> List[List[bytes]]:
+        """All blocks' parity in one batched bit-matmul per shard length.
+
+        The N per-proposer encodes of an epoch share one (k, m) codec and
+        (near-always) one framed length, so they collapse into a single
+        (8m × 8k) @ (8k × N·L) MXU product — the "N parallel encodes
+        become one matmul" plank of the north star."""
+        if not device_rs_enabled() or not datas or codec.m == 0:
+            return CryptoBackend.rs_encode_batch(self, codec, datas)
+        key = (codec.k, codec.m)
+        bits = self._rs_enc_bits.get(key)
+        if bits is None:
+            bits = self._rs_enc_bits[key] = jnp.asarray(
+                expand_gf_matrix(codec.encode_matrix)
+            )
+        out: List[Optional[List[bytes]]] = [None] * len(datas)
+        by_len: Dict[int, List[int]] = {}
+        for i, d in enumerate(datas):
+            by_len.setdefault(codec.shard_length(len(d)), []).append(i)
+        k, m = codec.k, codec.m
+        for shard_len, idxs in by_len.items():
+            with self._host_assembly():
+                stack = np.empty((len(idxs), k, shard_len), dtype=np.uint8)
+                for row, i in enumerate(idxs):
+                    padded = datas[i].ljust(shard_len * k, b"\0")
+                    stack[row] = np.frombuffer(
+                        padded, dtype=np.uint8
+                    ).reshape(k, shard_len)
+                # (G, k, L) → (k, G·L): per-block columns concatenate, so
+                # the whole group is ONE matmul against the shared matrix
+                mat = np.ascontiguousarray(stack.transpose(1, 0, 2)).reshape(
+                    k, len(idxs) * shard_len
+                )
+                placed = self._place(
+                    (bits, jnp.asarray(mat)), pipelined=True
+                )
+            self.counters.device_dispatches += 1
+
+            def deliver(parity, idxs=tuple(idxs), stack=stack, L=shard_len):
+                par = parity.reshape(m, len(idxs), L)
+                for row, i in enumerate(idxs):
+                    out[i] = [stack[row, j].tobytes() for j in range(k)] + [
+                        par[j, row].tobytes() for j in range(m)
+                    ]
+
+            self._dispatch_async(
+                gf256_matmul, placed, kind="rs_enc", items=len(idxs),
+                on_result=deliver,
+            )
+        self._pipe.flush()
+        return out  # type: ignore[return-value]
+
+    def rs_reconstruct_batch(
+        self, codec, shard_lists: Sequence[Sequence[Optional[bytes]]]
+    ) -> List[List[bytes]]:
+        """All erasure repairs in one batched decode matmul per pattern.
+
+        Items are grouped by (present-k indices, missing indices, shard
+        length) — the decode matrix is a constant per such pattern, served
+        from the bounded LRU.  Error cases (wrong slot count, too few
+        shards) and the zero-math all-present case run the host codec
+        inline, in item order, so raises and results match the host loop
+        exactly."""
+        if not device_rs_enabled() or not shard_lists:
+            return CryptoBackend.rs_reconstruct_batch(self, codec, shard_lists)
+        sls = [list(s) for s in shard_lists]
+        out: List[Optional[List[bytes]]] = [None] * len(sls)
+        groups: Dict[Tuple, List[int]] = {}
+        for i, shards in enumerate(sls):
+            present = [j for j, s in enumerate(shards) if s is not None]
+            if len(shards) != codec.n or len(present) < codec.k:
+                out[i] = codec.reconstruct(shards)  # the exact host raise
+                continue
+            missing = tuple(j for j, s in enumerate(shards) if s is None)
+            if not missing:
+                # all-present: zero GF math on the host path too
+                out[i] = codec.reconstruct(shards)
+                continue
+            xs = tuple(present[: codec.k])
+            lens = {len(shards[j]) for j in xs}
+            if len(lens) != 1:
+                # ragged present shards: host np.stack raises — keep it
+                out[i] = codec.reconstruct(shards)
+                continue
+            groups.setdefault((xs, missing, lens.pop()), []).append(i)
+        for (xs, missing, shard_len), idxs in groups.items():
+            with self._host_assembly():
+                bits = self._rs_dec_cache.get(xs, missing)
+                stack = np.empty(
+                    (len(idxs), codec.k, shard_len), dtype=np.uint8
+                )
+                for row, i in enumerate(idxs):
+                    for col, j in enumerate(xs):
+                        stack[row, col] = np.frombuffer(sls[i][j], dtype=np.uint8)
+                mat = np.ascontiguousarray(stack.transpose(1, 0, 2)).reshape(
+                    codec.k, len(idxs) * shard_len
+                )
+                placed = self._place(
+                    (bits, jnp.asarray(mat)), pipelined=True
+                )
+            self.counters.device_dispatches += 1
+
+            def deliver(rec, idxs=tuple(idxs), missing=missing, L=shard_len):
+                r = rec.reshape(len(missing), len(idxs), L)
+                for row, i in enumerate(idxs):
+                    full = list(sls[i])
+                    for mrow, midx in enumerate(missing):
+                        full[midx] = r[mrow, row].tobytes()
+                    out[i] = full
+
+            self._dispatch_async(
+                gf256_matmul, placed, kind="rs_dec", items=len(idxs),
+                on_result=deliver,
+            )
+        self._pipe.flush()
+        return out  # type: ignore[return-value]
+
+    def merkle_build_batch(self, shard_lists: Sequence[Sequence[bytes]]) -> List[Any]:
+        """All proposers' Merkle trees in one batched SHA-256 dispatch.
+
+        Requires the rectangular case (uniform leaf count + length across
+        the batch — the epoch's trees, all over one codec's shards); any
+        other shape falls back to the host loop, including its empty-tree
+        raise.  The fetched levels are adopted without re-hashing
+        (MerkleTree.from_levels), so the trees are bit-identical to
+        host-built ones."""
+        sls = [list(sl) for sl in shard_lists]
+        if not device_rs_enabled() or not sls or not sls[0]:
+            return CryptoBackend.merkle_build_batch(self, sls)
+        n = len(sls[0])
+        leaf_len = len(sls[0][0])
+        if any(
+            len(sl) != n or any(len(v) != leaf_len for v in sl) for sl in sls
+        ):
+            return CryptoBackend.merkle_build_batch(self, sls)
+        with self._host_assembly():
+            leaves = np.frombuffer(
+                b"".join(b"".join(sl) for sl in sls), dtype=np.uint8
+            ).reshape(len(sls), n, leaf_len)
+            placed = self._place((jnp.asarray(leaves),), pipelined=True)
+        self.counters.device_dispatches += 1
+        trees: List[Any] = [None] * len(sls)
+
+        def deliver(levels):
+            for ti, sl in enumerate(sls):
+                trees[ti] = MerkleTree.from_levels(
+                    sl,
+                    [
+                        [lvl[ti, j].tobytes() for j in range(lvl.shape[1])]
+                        for lvl in levels
+                    ],
+                )
+
+        self._dispatch_async(
+            sha256_device.tree_levels, placed, kind="merkle",
+            items=len(sls), on_result=deliver,
+        )
+        self._pipe.flush()
+        return trees
+
+    def merkle_verify_batch(self, packed, reps: int = 1) -> List[bool]:
+        """All N² packed proofs walked on device, ``reps`` times.
+
+        The repetition contract (one hash workload per simulated
+        receiver) is preserved as ``reps`` SEPARATE dispatches over the
+        same placed arrays — a reps-times loop inside one jit would be
+        CSE'd to a single walk and under-measure the plane.  Verdicts are
+        delivered from the first repetition."""
+        if not device_rs_enabled() or not len(packed):
+            return CryptoBackend.merkle_verify_batch(self, packed, reps=reps)
+        with self._host_assembly():
+            placed = self._place(
+                (
+                    jnp.asarray(packed.leaves),
+                    jnp.asarray(packed.paths),
+                    jnp.asarray(packed.indices),
+                    jnp.asarray(packed.roots),
+                ),
+                pipelined=True,
+            )
+        verdicts: List[Any] = []
+        for rep in range(max(1, int(reps))):
+            self.counters.device_dispatches += 1
+            self._dispatch_async(
+                sha256_device.verify_proofs, placed, kind="merkle",
+                items=len(packed),
+                on_result=verdicts.append if rep == 0 else None,
+            )
+        self._pipe.flush()
+        return [bool(v) for v in verdicts[0]]
 

@@ -43,6 +43,8 @@ def expand_gf_matrix(m: np.ndarray) -> np.ndarray:
     byte ``r``; input bit-column ``8*i + j`` is bit ``j`` of input byte ``i``.
     """
     gf = gf256()
+    # lint: allow[deferred-fetch] host-constant prep: the input is a host
+    # numpy GF matrix (encode/Lagrange rows), never a device value
     m = np.asarray(m, dtype=np.uint8)
     r, k = m.shape
     out = np.zeros((8 * r, 8 * k), dtype=np.int8)
@@ -108,6 +110,46 @@ def gf256_matmul(mbits: jnp.ndarray, x: jnp.ndarray) -> jnp.ndarray:
     return _pack_bits(acc & 1)
 
 
+class DecodeMatrixCache:
+    """Bounded LRU of bit-expanded GF(2⁸) decode matrices, keyed by
+    erasure pattern.
+
+    A decode matrix depends only on ``(xs, missing)`` — the first-k
+    present shard indices and the missing indices — so every epoch that
+    sees the same erasure pattern (the common case: a stable crashed-set
+    repeats for many epochs) reuses one device constant.  Distinct
+    patterns are combinatorially many, hence the bound: at ``capacity``
+    entries the least-recently-used pattern is evicted (pinned in
+    tests/test_device_rs.py).  Used by both the per-codec JaxRSCodec
+    hot path and the backend-global batched plane (ops/backend.py).
+    """
+
+    def __init__(self, capacity: int = 64) -> None:
+        self.capacity = capacity
+        self._cache: OrderedDict = OrderedDict()
+
+    def __len__(self) -> int:
+        return len(self._cache)
+
+    def keys(self):
+        return self._cache.keys()
+
+    def get(self, xs, missing) -> jnp.ndarray:
+        """The (8·|missing| × 8·k) F₂ bit matrix mapping values at ``xs``
+        to values at ``missing`` (device constant; built on miss)."""
+        key = (tuple(xs), tuple(missing))
+        hit = self._cache.get(key)
+        if hit is not None:
+            self._cache.move_to_end(key)
+            return hit
+        if len(self._cache) >= self.capacity:
+            self._cache.popitem(last=False)
+        mat = gf256().lagrange_matrix(list(xs), list(missing))
+        bits = jnp.asarray(expand_gf_matrix(mat))
+        self._cache[key] = bits
+        return bits
+
+
 class JaxRSCodec(RSCodec):
     """Systematic (k data, m parity) RS codec with a TPU matmul hot path.
 
@@ -115,7 +157,7 @@ class JaxRSCodec(RSCodec):
     :class:`~hbbft_tpu.crypto.erasure.RSCodec` (shards interoperate); only
     the GF(2⁸) matrix products are overridden to run as device bit-matmuls.
     Decode matrices (one per erasure pattern) are bit-expanded lazily and
-    kept in a small LRU cache.
+    kept in a small LRU cache (:class:`DecodeMatrixCache`).
     """
 
     _DECODE_CACHE_MAX = 64
@@ -123,7 +165,7 @@ class JaxRSCodec(RSCodec):
     def __init__(self, data_shards: int, parity_shards: int) -> None:
         super().__init__(data_shards, parity_shards)
         self._encode_bits = jnp.asarray(expand_gf_matrix(self.encode_matrix))
-        self._decode_cache: OrderedDict = OrderedDict()
+        self._decode_cache = DecodeMatrixCache(self._DECODE_CACHE_MAX)
 
     def encode_matrix_fn(self):
         """The jitted parity kernel: (k, L) uint8 → (m, L) uint8."""
@@ -133,15 +175,14 @@ class JaxRSCodec(RSCodec):
     # -- overridden matrix products ------------------------------------------
 
     def _parity(self, mat: np.ndarray) -> np.ndarray:
+        # lint: allow[deferred-fetch] synchronous golden/bench entry point —
+        # the engine's hot path routes through ops/backend.py, which fetches
+        # via the DispatchPipeline seam
         return np.asarray(gf256_matmul(self._encode_bits, jnp.asarray(mat)))
 
     def _interpolate(self, xs, missing, stack: np.ndarray) -> np.ndarray:
-        key = (tuple(xs), tuple(missing))
-        if key not in self._decode_cache:
-            if len(self._decode_cache) >= self._DECODE_CACHE_MAX:
-                self._decode_cache.popitem(last=False)
-            mat = gf256().lagrange_matrix(list(xs), list(missing))
-            self._decode_cache[key] = jnp.asarray(expand_gf_matrix(mat))
-        else:
-            self._decode_cache.move_to_end(key)
-        return np.asarray(gf256_matmul(self._decode_cache[key], jnp.asarray(stack)))
+        bits = self._decode_cache.get(xs, missing)
+        # lint: allow[deferred-fetch] synchronous golden/bench entry point —
+        # the engine's hot path routes through ops/backend.py, which fetches
+        # via the DispatchPipeline seam
+        return np.asarray(gf256_matmul(bits, jnp.asarray(stack)))

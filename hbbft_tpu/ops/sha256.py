@@ -1,0 +1,229 @@
+"""Batched SHA-256 on the accelerator: the hash half of the device
+erasure/hash plane (PR 19).
+
+The RBC plane is O(N²) hashes per epoch — N proposers × N Merkle proofs
+over RS shards — and `crypto/merkle.py` frames all of them with two
+fixed-shape message forms:
+
+* leaf hash:  ``sha256(b"\\x00" + data)`` — uniform ``leaf_len`` across a
+  proposer batch (RS shards of one encode share a length);
+* node hash:  ``sha256(b"\\x01" + left32 + right32)`` — always 65 bytes.
+
+Fixed shapes mean SHA-256's padding is STATIC per trace, so the whole
+plane vectorizes as plain ``uint32`` array ops batched over a leading
+axis: message schedule + 64-round compression with no per-item control
+flow, rounds and blocks both folded with ``lax.scan`` so the graph stays
+O(1) in rounds and leaf length (see ``_compress`` for the compile-budget
+rationale).  No Pallas kernel is needed — the compression is
+element-wise u32 arithmetic the XLA fusion already handles; the win is
+batching, not a hand-tiled loop.
+
+Entry points (all ``jax.jit``, retraced per shape):
+
+* :func:`leaf_hashes` / :func:`node_hashes` — tagged hashing primitives.
+* :func:`tree_levels` — all T proposers' full Merkle levels in one
+  dispatch (leaves padded to the power-of-two with ``_h_leaf(b"")``,
+  matching ``MerkleTree.__init__`` bit for bit).
+* :func:`verify_proofs` — the batched proof walk over
+  ``crypto/merkle.PackedProofs``-shaped arrays (leaves, paths, indices,
+  roots → per-proof booleans), the device twin of
+  ``native.merkle_validate_batch``.
+
+Everything here returns device arrays; fetching is the caller's job
+(``ops/backend.py`` routes results through the DispatchPipeline seam).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# FIPS 180-4 constants.
+_K = np.array(
+    [
+        0x428A2F98, 0x71374491, 0xB5C0FBCF, 0xE9B5DBA5,
+        0x3956C25B, 0x59F111F1, 0x923F82A4, 0xAB1C5ED5,
+        0xD807AA98, 0x12835B01, 0x243185BE, 0x550C7DC3,
+        0x72BE5D74, 0x80DEB1FE, 0x9BDC06A7, 0xC19BF174,
+        0xE49B69C1, 0xEFBE4786, 0x0FC19DC6, 0x240CA1CC,
+        0x2DE92C6F, 0x4A7484AA, 0x5CB0A9DC, 0x76F988DA,
+        0x983E5152, 0xA831C66D, 0xB00327C8, 0xBF597FC7,
+        0xC6E00BF3, 0xD5A79147, 0x06CA6351, 0x14292967,
+        0x27B70A85, 0x2E1B2138, 0x4D2C6DFC, 0x53380D13,
+        0x650A7354, 0x766A0ABB, 0x81C2C92E, 0x92722C85,
+        0xA2BFE8A1, 0xA81A664B, 0xC24B8B70, 0xC76C51A3,
+        0xD192E819, 0xD6990624, 0xF40E3585, 0x106AA070,
+        0x19A4C116, 0x1E376C08, 0x2748774C, 0x34B0BCB5,
+        0x391C0CB3, 0x4ED8AA4A, 0x5B9CCA4F, 0x682E6FF3,
+        0x748F82EE, 0x78A5636F, 0x84C87814, 0x8CC70208,
+        0x90BEFFFA, 0xA4506CEB, 0xBEF9A3F7, 0xC67178F2,
+    ],
+    dtype=np.uint32,
+)
+_H0 = np.array(
+    [
+        0x6A09E667, 0xBB67AE85, 0x3C6EF372, 0xA54FF53A,
+        0x510E527F, 0x9B05688C, 0x1F83D9AB, 0x5BE0CD19,
+    ],
+    dtype=np.uint32,
+)
+
+
+def _rotr(x, n: int):
+    return (x >> jnp.uint32(n)) | (x << jnp.uint32(32 - n))
+
+
+def _compress(state, w):
+    """One compression round over a batch: ``state`` (B, 8) u32 +
+    message-block words ``w`` (B, 16) u32 → new (B, 8) state.
+
+    Both the 48-word schedule expansion and the 64 rounds run as
+    ``lax.scan``s, NOT unrolled python loops: the first cut unrolled
+    ≈650 element-wise ops per entry point and XLA:CPU spent ~10 s
+    compiling EACH (entry point × shape) — across the engine's tree /
+    proof shapes that blew straight through the 870 s tier-1 window
+    (the same compile-budget lesson as the PR-4 GLV table build).  The
+    scan body is ~15 ops, so per-shape compiles drop to well under a
+    second; multi-block messages scan over this whole function."""
+    n_batch = w.shape[0]
+
+    def sched(win, _):
+        # win: (B, 16) rolling window of words t-16 .. t-1
+        w15 = win[:, 1]
+        w2 = win[:, 14]
+        s0 = _rotr(w15, 7) ^ _rotr(w15, 18) ^ (w15 >> jnp.uint32(3))
+        s1 = _rotr(w2, 17) ^ _rotr(w2, 19) ^ (w2 >> jnp.uint32(10))
+        nw = win[:, 0] + s0 + win[:, 9] + s1
+        return jnp.concatenate([win[:, 1:], nw[:, None]], axis=1), nw
+
+    _, extra = jax.lax.scan(sched, w, None, length=48)  # (48, B)
+    ws = jnp.concatenate([jnp.swapaxes(w, 0, 1), extra], axis=0)  # (64, B)
+
+    def rnd(vars8, wk):
+        a, b, c, d, e, f, g, h = vars8
+        wt, kt = wk
+        s1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
+        ch = (e & f) ^ (~e & g)
+        t1 = h + s1 + ch + kt + wt
+        s0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
+        maj = (a & b) ^ (a & c) ^ (b & c)
+        return (t1 + s0 + maj, a, b, c, d + t1, e, f, g), None
+
+    init = tuple(state[:, i] for i in range(8))
+    ks = jnp.broadcast_to(jnp.asarray(_K)[:, None], (64, n_batch))
+    out, _ = jax.lax.scan(rnd, init, (ws, ks))
+    return jnp.stack(out, axis=1) + state
+
+
+def _pad_tagged(msgs, tag: int):
+    """FIPS padding for ``tag_byte + msgs[i]``, batched: (B, L) u8 →
+    (B, nblocks, 16) big-endian u32 words.  ``L`` is static per trace,
+    so the pad layout is a compile-time constant."""
+    n_msgs, msg_len = msgs.shape
+    total = msg_len + 1  # tag byte
+    nblocks = (total + 9 + 63) // 64
+    fill = nblocks * 64 - total - 8
+    bitlen = total * 8
+    tag_col = jnp.full((n_msgs, 1), tag, dtype=jnp.uint8)
+    mid = jnp.zeros((n_msgs, fill), dtype=jnp.uint8).at[:, 0].set(0x80)
+    lenbytes = jnp.asarray(
+        [(bitlen >> (8 * (7 - i))) & 0xFF for i in range(8)], dtype=jnp.uint8
+    )
+    buf = jnp.concatenate(
+        [tag_col, msgs, mid, jnp.broadcast_to(lenbytes, (n_msgs, 8))], axis=1
+    )
+    quads = buf.reshape(n_msgs, nblocks, 16, 4).astype(jnp.uint32)
+    return (
+        (quads[..., 0] << jnp.uint32(24))
+        | (quads[..., 1] << jnp.uint32(16))
+        | (quads[..., 2] << jnp.uint32(8))
+        | quads[..., 3]
+    )
+
+
+def _digest_bytes(state):
+    """(B, 8) u32 state → (B, 32) u8 big-endian digests."""
+    shifts = jnp.asarray([24, 16, 8, 0], dtype=jnp.uint32)
+    parts = (state[:, :, None] >> shifts[None, None, :]) & jnp.uint32(0xFF)
+    return parts.reshape(state.shape[0], 32).astype(jnp.uint8)
+
+
+def _sha256_tagged(msgs, tag: int):
+    """sha256(bytes([tag]) + m) for every row of ``msgs`` ((B, L) u8 →
+    (B, 32) u8).  Blocks fold under ``lax.scan`` so long leaves don't
+    inflate the graph."""
+    words = _pad_tagged(msgs, tag)
+    state0 = jnp.broadcast_to(jnp.asarray(_H0), (msgs.shape[0], 8))
+
+    def body(state, block):
+        return _compress(state, block), None
+
+    state, _ = jax.lax.scan(body, state0, jnp.swapaxes(words, 0, 1))
+    return _digest_bytes(state)
+
+
+@jax.jit
+def leaf_hashes(leaves):
+    """Batched ``merkle._h_leaf``: (B, L) u8 → (B, 32) u8."""
+    return _sha256_tagged(leaves, 0)
+
+
+@jax.jit
+def node_hashes(left, right):
+    """Batched ``merkle._h_node``: two (B, 32) u8 → (B, 32) u8."""
+    return _sha256_tagged(jnp.concatenate([left, right], axis=1), 1)
+
+
+@jax.jit
+def tree_levels(leaves):
+    """All T proposers' Merkle levels in one dispatch.
+
+    ``leaves``: (T, n, leaf_len) u8 real leaves.  Returns the tuple of
+    levels ((T, size, 32), (T, size/2, 32), …, (T, 1, 32)) with ``size``
+    the next power of two ≥ n and missing leaves padded with
+    ``_h_leaf(b"")`` — the exact construction of ``MerkleTree.__init__``,
+    so a host tree built from these levels is bit-identical to one that
+    hashed on the host."""
+    n_trees, n_leaves, leaf_len = leaves.shape
+    size = 1
+    while size < n_leaves:
+        size *= 2
+    level = _sha256_tagged(leaves.reshape(n_trees * n_leaves, leaf_len), 0)
+    level = level.reshape(n_trees, n_leaves, 32)
+    if size > n_leaves:
+        pad = _sha256_tagged(jnp.zeros((1, 0), dtype=jnp.uint8), 0)
+        level = jnp.concatenate(
+            [level, jnp.broadcast_to(pad[None], (n_trees, size - n_leaves, 32))],
+            axis=1,
+        )
+    levels = [level]
+    while level.shape[1] > 1:
+        half = level.shape[1] // 2
+        left = level[:, 0::2].reshape(n_trees * half, 32)
+        right = level[:, 1::2].reshape(n_trees * half, 32)
+        level = _sha256_tagged(
+            jnp.concatenate([left, right], axis=1), 1
+        ).reshape(n_trees, half, 32)
+        levels.append(level)
+    return tuple(levels)
+
+
+@jax.jit
+def verify_proofs(leaves, paths, indices, roots):
+    """Batched Merkle proof walk over PackedProofs-shaped arrays.
+
+    ``leaves`` (P, leaf_len) u8, ``paths`` (P, depth, 32) u8 sibling
+    chains, ``indices`` (P,) int leaf positions, ``roots`` (P, 32) u8 —
+    returns (P,) bool, one verdict per proof.  Same walk as
+    ``merkle.Proof.validate``: at depth d the accumulator is the left
+    operand iff bit d of the index is 0."""
+    acc = _sha256_tagged(leaves, 0)
+    idx = indices.astype(jnp.int32)
+    for d in range(paths.shape[1]):
+        sib = paths[:, d]
+        is_left = ((idx >> d) & 1) == 0
+        left = jnp.where(is_left[:, None], acc, sib)
+        right = jnp.where(is_left[:, None], sib, acc)
+        acc = _sha256_tagged(jnp.concatenate([left, right], axis=1), 1)
+    return jnp.all(acc == roots, axis=1)

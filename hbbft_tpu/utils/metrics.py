@@ -136,6 +136,11 @@ class Counters:
     device_seconds_dkg: float = 0.0  # batched era-change DKG ladders/MSMs
     device_seconds_encrypt: float = 0.0  # batched threshold-encrypt ladders
     device_seconds_glv_ab: float = 0.0  # glv_ladder_ab bench-row dispatches
+    # device erasure/hash plane (PR 19): RS encode / reconstruct bit-matmuls
+    # and Merkle tree-build + proof-verify SHA-256 dispatches
+    device_seconds_rs_enc: float = 0.0  # batched GF(2⁸) parity matmuls
+    device_seconds_rs_dec: float = 0.0  # batched GF(2⁸) decode matmuls
+    device_seconds_merkle: float = 0.0  # batched device SHA-256 (build+verify)
 
     def snapshot(self) -> Dict[str, float]:
         return asdict(self)

@@ -14,6 +14,8 @@ through jax.config instead (XLA_FLAGS is still read lazily at backend init).
 import os
 import sys
 
+import pytest
+
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -80,11 +82,20 @@ def _isolate_modules():
 _isolated_selected = {}  # module path -> [nodeid, ...] selected in THIS run
 
 
+@pytest.hookimpl(trylast=True)
 def pytest_collection_modifyitems(config, items):
     """Run the heavy (isolated-subprocess) modules FIRST so their
     failures surface early and the light tests stream afterwards; record
     which of their tests survived -k/-m/nodeid selection so the
-    subprocess runs exactly those."""
+    subprocess runs exactly those.
+
+    ``trylast`` matters: conftest hookimpls run BEFORE the builtin mark
+    plugin's, so a plain impl here saw the PRE-deselection item list and
+    recorded ``-m 'not slow'``-excluded nodeids into the subprocess run
+    (the subprocess gets explicit nodeids, which override markers) —
+    tier-1 silently re-included every slow test in the heavy set and
+    blew the 870 s window.  trylast runs after deselect_by_mark, so only
+    the surviving items are recorded."""
     heavy = tuple(os.path.basename(m).removesuffix(".py") for m in _isolate_modules())
     items.sort(
         key=lambda it: 0 if any(h in it.nodeid for h in heavy) else 1

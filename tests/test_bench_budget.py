@@ -91,6 +91,20 @@ def test_mesh_scaling_planned_both_modes(bench):
     assert "mesh_scaling" in bench._BENCH_EST_S
 
 
+def test_rs_plane_ab_planned_both_modes(bench):
+    """The device erasure/hash plane A/B row (PR 19) rides both
+    orderings next to the rs_encode/rs_host kernel rows — a support
+    diagnostic, so under a budget it stays behind the flagship prefix —
+    with a cost estimate."""
+    for budget in (0.0, 3000.0):
+        names = [n for n, _ in bench._plan_benches(None, "tpu", budget)]
+        assert "rs_plane_ab" in names
+        assert names.index("rs_host") < names.index("rs_plane_ab")
+    budgeted = [n for n, _ in bench._plan_benches(None, "tpu", 3000.0)]
+    assert budgeted.index("array_n100_tpu") < budgeted.index("rs_plane_ab")
+    assert "rs_plane_ab" in bench._BENCH_EST_S
+
+
 def test_n100_tpu_gating(bench):
     # off-TPU driver runs never attempt the real-crypto N=100 row...
     assert "array_n100_tpu" not in [

@@ -97,3 +97,11 @@ def test_glv_ab_bench_kind_registered():
     attributable separately from real DKG work — the kind must exist as
     a Counters field or the dispatch would be unkinded."""
     assert "glv_ab" in _counters_kinds()
+
+
+def test_device_rs_plane_kinds_registered():
+    """The device erasure/hash plane (PR 19) dispatches RS encode,
+    RS decode, and Merkle build/verify chunks under their own kinds so
+    the folded host buckets reappear attributed inside device_seconds —
+    every kind must exist as a Counters field."""
+    assert {"rs_enc", "rs_dec", "merkle"} <= _counters_kinds()

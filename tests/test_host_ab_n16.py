@@ -27,6 +27,12 @@ def test_n16_real_crypto_host_seconds_halves(monkeypatch):
     from hbbft_tpu.ops.backend import TpuBackend
 
     def arm(no_hostpipe):
+        # both arms pinned to the host codec: this A/B isolates the
+        # HOSTPIPE axis, and the legacy arm's verbatim per-item loops
+        # never ride the device RS/Merkle plane — leaving the plane on
+        # would skew device_dispatches between arms (the plane has its
+        # own A/B: tests/test_device_rs.py and the rs_plane window step)
+        monkeypatch.setenv("HBBFT_TPU_NO_DEVICE_RS", "1")
         if no_hostpipe:
             monkeypatch.setenv("HBBFT_TPU_NO_HOSTPIPE", "1")
             monkeypatch.setenv("HBBFT_TPU_NO_PIPELINE", "1")

@@ -113,6 +113,9 @@ done_n64coin() {
 done_rs_ab() {
   has_row "$ART/rows_after_rs_ab.json" rs_encode_throughput
 }
+done_rs_plane() {
+  has_row "$ART/rows_after_rs_plane.json" rs_plane_ab
+}
 done_kernel_levers() {
   # completion marker written at the END of the step: a mid-step death
   # must re-run it (the first sub-command already prints fused-chain
@@ -155,13 +158,18 @@ do_host_ab() {
   # dispatch pipeline — the kill-switch arm is the strictly serial
   # pre-PR host.  3 epochs per arm keeps both inside one short window;
   # the per-bucket host split lands on each row (host_buckets field).
-  HBBFT_TPU_FQ_IMPL=rns BENCH_ONLY=array_n100 BENCH_ARRAY_BACKEND=tpu \
+  # HBBFT_TPU_NO_DEVICE_RS=1 pins BOTH arms to the host codec (PR 19):
+  # this step isolates the HOSTPIPE axis, and its bucket series stays
+  # comparable with pre-PR-19 rounds; the device erasure/hash plane has
+  # its own A/B step (rs_plane below).
+  HBBFT_TPU_NO_DEVICE_RS=1 \
+    HBBFT_TPU_FQ_IMPL=rns BENCH_ONLY=array_n100 BENCH_ARRAY_BACKEND=tpu \
     BENCH_ARRAY_EPOCHS=3 BENCH_ARRAY_CHURN=0 \
     BENCH_SERIES="$ART/series_host_ab.jsonl" \
     timeout 7200 python bench.py
   SNAP host_ab
   ALIVE
-  HBBFT_TPU_NO_HOSTPIPE=1 HBBFT_TPU_NO_PIPELINE=1 \
+  HBBFT_TPU_NO_HOSTPIPE=1 HBBFT_TPU_NO_PIPELINE=1 HBBFT_TPU_NO_DEVICE_RS=1 \
     HBBFT_TPU_FQ_IMPL=rns BENCH_ONLY=array_n100 BENCH_ARRAY_BACKEND=tpu \
     BENCH_ARRAY_EPOCHS=3 BENCH_ARRAY_CHURN=0 \
     BENCH_SERIES="$ART/series_host_ab_off.jsonl" \
@@ -205,6 +213,15 @@ do_rs_ab() {
   SNAP rs_bf16
   BENCH_ONLY=rs_encode HBBFT_TPU_GF_DOT=bf16 BENCH_RS_SHARD=65536 \
     timeout 900 python bench.py
+}
+do_rs_plane() {
+  # Device erasure/hash plane A/B (PR 19): batched RS encode/reconstruct
+  # bit-matmuls + device SHA-256 Merkle build/verify through the
+  # TpuBackend plane entry points, vs the host codec kill switch
+  # (HBBFT_TPU_NO_DEVICE_RS read per call, in-process A/B) — at the
+  # N=16 and the N=100 f=33 shapes.  Cheap kernel row; the measurement
+  # protocol (bucket-fold acceptance) is PERF.md round 15.
+  HBBFT_TPU_FQ_IMPL=rns BENCH_ONLY=rs_plane_ab timeout 1800 python bench.py
 }
 do_kernel_levers() {
   # body runs under -e/pipefail so a failed sub-command (timeout rc=124,
@@ -348,7 +365,7 @@ do_n100_churn() {
     timeout 18000 python bench.py
 }
 
-STEPS="n100 matrix_rns_a matrix_limb_a matrix_rns_b matrix_limb_b glv_ab host_ab adv_matrix qhb_traffic slo_traffic crash_matrix mesh_scaling n16_churn flips10k kernel_levers driver_budget rs_ab n32_churn n64coin n100_churn"
+STEPS="n100 matrix_rns_a matrix_limb_a matrix_rns_b matrix_limb_b glv_ab host_ab adv_matrix qhb_traffic slo_traffic crash_matrix mesh_scaling n16_churn flips10k kernel_levers driver_budget rs_ab rs_plane n32_churn n64coin n100_churn"
 
 for s in $STEPS; do
   if "done_$s"; then

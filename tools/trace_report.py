@@ -269,21 +269,31 @@ def check_host_buckets(
     expected: float,
     tol: float = 0.05,
     max_unattributed: float = 0.10,
+    folded_device_seconds: float = 0.0,
 ) -> Tuple[bool, Dict[str, float]]:
     """Acceptance check for the host attribution (PR 5): the traced
     bucket partition must sum to ``expected`` (counters.host_seconds, or
     host_seconds_per_epoch × epochs from a bench row) within ``tol``
     relative, AND the residual ``other`` bucket must stay under
     ``max_unattributed`` of the total — i.e. the named buckets cover
-    ≥ 1 − max_unattributed of the epoch's host time.  Returns
-    (ok, buckets)."""
+    ≥ 1 − max_unattributed of the epoch's host time.
+
+    ``folded_device_seconds`` (PR 19) is the device time of work that
+    used to be host buckets — with the device erasure/hash plane on,
+    encode/rs_merkle legitimately fall to ~0 and host_seconds shrinks by
+    the folded amount, which would inflate every remaining bucket's
+    SHARE; the unattributed bound is therefore judged against the
+    pre-fold scale (expected + folded).  The sum check is unchanged:
+    the buckets must still account for the host time that remains.
+    Returns (ok, buckets)."""
     buckets = host_bucket_seconds(events)
     total = sum(buckets.values())
     if expected <= 0:
         return (total == 0.0, buckets)
     ok = (
         abs(total - expected) <= tol * expected
-        and buckets.get("other", 0.0) <= max_unattributed * expected
+        and buckets.get("other", 0.0)
+        <= max_unattributed * (expected + max(0.0, folded_device_seconds))
     )
     return ok, buckets
 
@@ -325,6 +335,7 @@ def report(
     tol: float = 0.05,
     host_buckets: Optional[float] = None,
     host_unattributed_max: float = 0.10,
+    host_folded_device: float = 0.0,
 ) -> int:
     events = load_events(path)
     errors = validate_chrome_trace(events)
@@ -369,7 +380,8 @@ def report(
             return 1
     if host_buckets is not None:
         ok, buckets = check_host_buckets(
-            events, host_buckets, tol, host_unattributed_max
+            events, host_buckets, tol, host_unattributed_max,
+            host_folded_device,
         )
         total = sum(buckets.values())
         print(f"{'host bucket':>12} {'seconds':>10} {'share':>7}")
@@ -377,11 +389,16 @@ def report(
             share = sec / host_buckets if host_buckets else 0.0
             print(f"{name:>12} {sec:>10.4f} {share:>6.1%}")
         verdict = "OK" if ok else "MISMATCH"
+        folded = (
+            f", folded device {host_folded_device:.4f} s"
+            if host_folded_device
+            else ""
+        )
         print(
             f"host-buckets check: buckets {total:.4f} s vs counter "
             f"{host_buckets:.4f} s (±{tol:.0%}), unattributed "
             f"{buckets.get('other', 0.0):.4f} s "
-            f"(max {host_unattributed_max:.0%}) — {verdict}"
+            f"(max {host_unattributed_max:.0%}{folded}) — {verdict}"
         )
         if not ok:
             return 1
@@ -404,6 +421,15 @@ def _rows_by_metric(path: str) -> Dict[str, Dict[str, Any]]:
     }
 
 
+#: A/B rows whose secondary arm / ratio must diff alongside the headline
+#: value — the rs_plane_ab row's ``value`` is the device-plane rate, so
+#: without these sub-metrics a host-arm collapse (or the device-vs-host
+#: ratio sliding under 1.0) would pass the diff unnoticed (PR 19)
+_AB_SUBMETRICS: Dict[str, Tuple[str, ...]] = {
+    "rs_plane_ab": ("host_blocks_per_sec", "device_vs_host"),
+}
+
+
 def diff_rows(
     old_path: str, new_path: str, tol: float = 0.10
 ) -> List[Dict[str, Any]]:
@@ -417,14 +443,30 @@ def diff_rows(
         if o is None or n is None:
             entry["status"] = "only_in_new" if o is None else "only_in_old"
             entry["regression"] = False
-        else:
-            entry["old"] = o["value"]
-            entry["new"] = n["value"]
-            entry["ratio"] = n["value"] / o["value"] if o["value"] else None
-            entry["regression"] = bool(
-                o["value"] and n["value"] < o["value"] * (1.0 - tol)
-            )
+            out.append(entry)
+            continue
+        entry["old"] = o["value"]
+        entry["new"] = n["value"]
+        entry["ratio"] = n["value"] / o["value"] if o["value"] else None
+        entry["regression"] = bool(
+            o["value"] and n["value"] < o["value"] * (1.0 - tol)
+        )
         out.append(entry)
+        for field in _AB_SUBMETRICS.get(metric, ()):
+            ov, nv = o.get(field), n.get(field)
+            if not isinstance(ov, (int, float)) or not isinstance(
+                nv, (int, float)
+            ):
+                continue
+            out.append(
+                {
+                    "metric": f"{metric}.{field}",
+                    "old": ov,
+                    "new": nv,
+                    "ratio": nv / ov if ov else None,
+                    "regression": bool(ov and nv < ov * (1.0 - tol)),
+                }
+            )
     return out
 
 
@@ -947,6 +989,14 @@ def main(argv=None) -> int:
         help="max unattributed ('other') share for --host-buckets "
         "(default 0.10)",
     )
+    p.add_argument(
+        "--host-folded-device", type=float, default=0.0,
+        help="device seconds of work folded OUT of the host buckets by "
+        "the device erasure/hash plane (counters.device_seconds_rs_enc "
+        "+ _rs_dec + _merkle); the --host-buckets unattributed bound is "
+        "judged against host_seconds + this, so a run with encode/"
+        "rs_merkle legitimately ~0 does not trip the gate",
+    )
     args = p.parse_args(argv)
     if args.forensics:
         return report_forensics(args.paths)
@@ -977,6 +1027,7 @@ def main(argv=None) -> int:
     return report(
         args.paths[0], args.device_seconds, args.device_tol,
         args.host_buckets, args.host_unattributed_max,
+        args.host_folded_device,
     )
 
 
