@@ -1490,6 +1490,87 @@ def bench_rs_plane_ab() -> dict:
     }
 
 
+def bench_fused_chain_ab() -> dict:
+    """VMEM-resident fused tower chain vs stacked kernels (``fused_chain_ab``,
+    PR 20): the grouped rlc_sig verification graph — the rlc_dec/rlc_sig
+    chain shape the ≥2G field-muls/s target is stated against — through
+    ``_jitted_rlc_sig(mode)`` in both compositions.  Steady-state
+    ``_time_fn`` discipline (compile untimed, fresh staged copies,
+    ``_touch``, host-fetch fence); bit-identical canonical readback
+    between arms asserted on a spot-checked group, plus env-ladder
+    routing in both directions (the kill switch must resolve to the
+    stacked graph).  The analytic launch/mul model (pairing_chain) turns
+    the fused wall into field-muls/s — the row's value — and reports the
+    per-verification Pallas-launch drop the ISSUE-20 ≥3× bar reads."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from hbbft_tpu.ops import curve, pairing, pairing_chain, tower
+    from hbbft_tpu.ops.backend import TpuBackend, _jitted_rlc_sig
+
+    g = _env_int("BENCH_FUSED_GROUPS", 64)
+    k = _env_int("BENCH_RLC_K", 32)
+    iters = _env_int("BENCH_ITERS", 3)
+
+    fused_mode = "native" if jax.default_backend() == "tpu" else "interpret"
+    saved = {
+        v: os.environ.pop(v, None)
+        for v in ("HBBFT_TPU_FUSED_TOWER", "HBBFT_TPU_NO_FUSED_TOWER")
+    }
+    try:
+        # env-ladder routing, both directions
+        os.environ["HBBFT_TPU_FUSED_TOWER"] = fused_mode
+        assert pairing_chain.fused_tower_mode() == fused_mode
+        os.environ["HBBFT_TPU_NO_FUSED_TOWER"] = "1"
+        assert pairing_chain.fused_tower_mode() is None, "kill switch leaked"
+        del os.environ["HBBFT_TPU_NO_FUSED_TOWER"]
+
+        S, PK, negG1, H = _synthetic_share_groups(g, k, seed=11)
+        rs = [[1 + i * 6007 + j for j in range(k)] for i in range(g)]
+        rbits = jnp.asarray(
+            np.stack(
+                [curve.scalars_to_bits(row, TpuBackend._rlc_bits()) for row in rs]
+            )
+        )
+        args = (S, PK, rbits, negG1, H)
+
+        fused_fn = _jitted_rlc_sig(fused_mode)
+        stacked_fn = _jitted_rlc_sig(None)
+        dt_fused = _time_fn(fused_fn, args, iters)
+        dt_stacked = _time_fn(stacked_fn, args, iters)
+
+        # bit-identical represented values between arms + real verdicts
+        out_f = jax.tree_util.tree_map(np.asarray, fused_fn(*args))
+        out_s = jax.tree_util.tree_map(np.asarray, stacked_fn(*args))
+        assert tower.fq12_to_ints_batch(out_f, g) == tower.fq12_to_ints_batch(
+            out_s, g
+        ), "fused arm diverged from stacked graph"
+        assert all(pairing.is_one_host_batch(out_f, g)), "verification wrong"
+
+        muls = pairing_chain.analytic_chain_field_muls(g)
+        launches_fused = pairing_chain.analytic_pallas_calls(2, fused=True)
+        launches_stacked = pairing_chain.analytic_pallas_calls(2, fused=False)
+        return {
+            "metric": "fused_chain_ab",
+            "value": round(muls / dt_fused, 2),
+            "unit": "field_muls/s",
+            "batch": g * k,
+            "groups": g,
+            "mode": fused_mode,
+            "shares_per_sec": round(g * k / dt_fused, 2),
+            "stacked_shares_per_sec": round(g * k / dt_stacked, 2),
+            "fused_vs_stacked": round(dt_stacked / dt_fused, 3),
+            "launch_drop": round(launches_stacked / launches_fused, 2),
+        }
+    finally:
+        for v, val in saved.items():
+            if val is None:
+                os.environ.pop(v, None)
+            else:
+                os.environ[v] = val
+
+
 def bench_epochs_n100() -> dict:
     """North-star macro shape: N=100 f=33 QHB epochs/sec, end to end.
 
@@ -2284,6 +2365,7 @@ _BENCH_EST_S = {
     "coin_e2e": 240, "rlc_dec_adversarial": 150, "array_n16_tpu": 420,
     "array_n100_tpu": 1200, "rs_encode": 120, "rs_host": 60,
     "rs_plane_ab": 180,
+    "fused_chain_ab": 240,
     "fq_kernel": 240, "n4": 60, "n4_realcrypto": 300, "n100": 420,
     "array_n256_soak": 300, "array_n100_dedup": 120, "array_n64_coin": 240,
     "array_n100": 300, "glv_ladder": 180, "adv_matrix": 600,
@@ -2326,7 +2408,10 @@ def _plan_benches(only, platform: str, budget: float) -> list:
             plan.append(("array_n16_tpu", bench_array_engine_n16_tpu))
             if platform == "tpu":
                 plan.append(("array_n100_tpu", bench_array_engine_n100_tpu))
-        # diagnostic A/B row — after the flagship prefix, before support
+        # diagnostic A/B rows — after the flagship prefix, before support
+        # (fused_chain_ab is the PR-20 device-chain A/B: it must survive
+        # a budget timeout, so it rides directly behind the flagships)
+        plan.append(("fused_chain_ab", bench_fused_chain_ab))
         plan.append(("glv_ladder", bench_glv_ladder))
         plan.append(("scenario_matrix", bench_scenario_matrix))
         plan.append(("crash_matrix", bench_crash_matrix))
@@ -2362,6 +2447,7 @@ def _plan_benches(only, platform: str, budget: float) -> list:
             ("rs_encode", bench_rs_encode),
             ("rs_host", bench_rs_host),
             ("rs_plane_ab", bench_rs_plane_ab),
+            ("fused_chain_ab", bench_fused_chain_ab),
             ("share_verify", bench_share_verify),
         ]
         if n4:

@@ -196,6 +196,11 @@ class SeamRaceRule(Rule):
         # closure locals, never ambient self attrs
         "hbbft_tpu/ops/gf256.py",
         "hbbft_tpu/ops/sha256.py",
+        # PR 20: the fused tower chain rides the same dispatch seam —
+        # any future module-level mutable routing state (caches, mode
+        # latches) shared with delivery callbacks gets inventoried here
+        "hbbft_tpu/ops/tower_fused.py",
+        "hbbft_tpu/ops/pairing_chain.py",
     )
 
     def check_module(self, mod: ModuleSource) -> List[Finding]:

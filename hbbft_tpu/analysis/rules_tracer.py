@@ -271,6 +271,11 @@ class DeferredFetchRule(Rule):
         # dispatch kind, so a stray fetch here is the same regression
         "hbbft_tpu/ops/gf256.py",
         "hbbft_tpu/ops/sha256.py",
+        # PR 20: the fused tower chain — its kernels/orchestration run
+        # INSIDE backend dispatch graphs, so a host fetch here would
+        # stall every fused_chain/rlc dispatch mid-trace
+        "hbbft_tpu/ops/tower_fused.py",
+        "hbbft_tpu/ops/pairing_chain.py",
     )
 
     def check_module(self, mod: ModuleSource) -> List[Finding]:

@@ -103,9 +103,17 @@ def _pad_zero_rows(bits, negs, pad: int):
 
 
 @functools.lru_cache(maxsize=None)
-def _jitted_product2():
-    """(P1, Q1, P2, Q2) → fq12 limbs of FE_fast(ML(P1,Q1)·ML(P2,Q2))."""
-    return jax.jit(pairing.product2_fast)
+def _jitted_product2(fused=None):
+    """(P1, Q1, P2, Q2) → fq12 limbs of FE_fast(ML(P1,Q1)·ML(P2,Q2)).
+
+    ``fused`` is the RESOLVED pairing_chain mode (None = stacked graph,
+    "native"/"interpret" = fused tower kernels); the jit cache is keyed
+    on it, so call sites that re-read the env ladder per call (the kill
+    switch HBBFT_TPU_NO_FUSED_TOWER) always hit the matching graph —
+    env flips can never serve a stale trace."""
+    return jax.jit(
+        functools.partial(pairing.product2_fast, fused=fused or False)
+    )
 
 
 @functools.lru_cache(maxsize=None)
@@ -152,12 +160,13 @@ def _squeeze_point(P):
 
 
 @functools.lru_cache(maxsize=None)
-def _jitted_rlc_sig():
+def _jitted_rlc_sig(fused=None):
     """Grouped sig-share check: e(G1, Σr·σ_i) == e(Σr·PK_i, H) per group.
 
     Inputs: S (G,k) G2 Jacobian shares, PK (G,k) G1 Jacobian key shares,
     rbits (G,k,RLC_BITS), negG1 (G,) affine −G1, H (G,) affine G2 points.
-    Returns fq12 limbs; host checks == 1 per group.
+    Returns fq12 limbs; host checks == 1 per group.  ``fused`` keys the
+    cache on the resolved pairing-chain mode (see _jitted_product2).
     """
 
     def f(S, PK, rbits, negG1, H):
@@ -166,16 +175,19 @@ def _jitted_rlc_sig():
         comb_pk = jax.vmap(curve.linear_combine_g1)(PK, rbits, zeros)
         s_aff = curve.jac_to_affine_g2(_squeeze_point(comb_s))
         pk_aff = curve.jac_to_affine_g1(_squeeze_point(comb_pk))
-        return pairing.product2_fast(negG1, s_aff, pk_aff, H)
+        return pairing.product2_fast(
+            negG1, s_aff, pk_aff, H, fused=fused or False
+        )
 
     return jax.jit(f)
 
 
 @functools.lru_cache(maxsize=None)
-def _jitted_rlc_dec():
+def _jitted_rlc_dec(fused=None):
     """Grouped dec-share check: e(Σr·D_i, H) == e(Σr·PK_i, W) per group.
 
     D and PK both live in G1; H, W are per-group affine G2 points.
+    ``fused`` keys the cache on the resolved pairing-chain mode.
     """
 
     def f(D, PK, rbits, H, W):
@@ -185,7 +197,7 @@ def _jitted_rlc_dec():
         d_aff = curve.jac_to_affine_g1(_squeeze_point(comb_d))
         pk_aff = curve.jac_to_affine_g1(_squeeze_point(comb_pk))
         neg_pk = (pk_aff[0], jnp.negative(pk_aff[1]), pk_aff[2])
-        return pairing.product2_fast(d_aff, H, neg_pk, W)
+        return pairing.product2_fast(d_aff, H, neg_pk, W, fused=fused or False)
 
     return jax.jit(f)
 
@@ -406,12 +418,42 @@ class TpuBackend(CryptoBackend):
                 quads[lo : lo + self.pairing_lane_cap], lo, write
             )
 
+    def _bill_chain(self, mode, lanes: int) -> None:
+        """Fused-chain accounting for one verification dispatch of
+        ``lanes`` pairing lanes: tally the analytic per-verification
+        kernel-launch counts of whichever composition is routing (the
+        ≥3× dispatch-drop A/B reads fused_chain_pallas_calls vs
+        stacked_chain_pallas_calls directly) and, on the fused arm, the
+        analytic Fq-mul count executed inside the fused kernels (the
+        muls/s numerator of the fused_chain_ab bench row)."""
+        from hbbft_tpu.ops import pairing_chain
+
+        c = self.counters
+        if mode:
+            c.fused_tower_calls += 1
+            c.fused_chain_field_muls += pairing_chain.analytic_chain_field_muls(
+                lanes
+            )
+            c.fused_chain_pallas_calls += pairing_chain.analytic_pallas_calls(
+                2, fused=True
+            )
+        else:
+            c.stacked_chain_pallas_calls += pairing_chain.analytic_pallas_calls(
+                2, fused=False
+            )
+
     def _submit_check_chunk(self, chunk, base: int, write) -> None:
+        from hbbft_tpu.ops import pairing_chain
+
         n = len(chunk)
         if n == 0:
             return
         self.counters.pairing_checks += n
         self.counters.device_dispatches += 1
+        # per-call routing resolve: the jit cache is keyed on the mode,
+        # so flipping HBBFT_TPU_NO_FUSED_TOWER mid-process restores the
+        # stacked graph exactly (no stale traces)
+        mode = pairing_chain.fused_tower_mode()
         g1 = self.group.g1()
         g2 = self.group.g2()
         pad = (g1, g2, g1, g2)  # trivially true
@@ -444,8 +486,10 @@ class TpuBackend(CryptoBackend):
                 for i in range(n):
                     write(base + i, pairing.is_one_host(f, i))
 
+        self._bill_chain(mode, b)
         self._dispatch_async(
-            _jitted_product2(), placed, kind="pairing", items=n,
+            _jitted_product2(mode), placed,
+            kind="fused_chain" if mode else "pairing", items=n,
             on_result=deliver,
         )
 
@@ -898,7 +942,14 @@ class TpuBackend(CryptoBackend):
             return (S_jac, PK_jac, neg_g1, H)
 
         def jitted(S_jac, PK_jac, neg_g1, H, rbits):
-            return _jitted_rlc_sig()(S_jac, PK_jac, rbits, neg_g1, H)
+            # per-dispatch routing resolve + fused-chain accounting; the
+            # dispatch KIND stays rlc_sig (the RLC bucket split is by
+            # workload, the fused/unfused split reads off the counters)
+            from hbbft_tpu.ops import pairing_chain
+
+            mode = pairing_chain.fused_tower_mode()
+            self._bill_chain(mode, rbits.shape[0])
+            return _jitted_rlc_sig(mode)(S_jac, PK_jac, rbits, neg_g1, H)
 
         cont = self._grouped_rlc(
             rlc_groups, items, build, jitted, results, direct,
@@ -990,7 +1041,11 @@ class TpuBackend(CryptoBackend):
             return (D_jac, PK_jac, H, W)
 
         def jitted(D_jac, PK_jac, H, W, rbits):
-            return _jitted_rlc_dec()(D_jac, PK_jac, rbits, H, W)
+            from hbbft_tpu.ops import pairing_chain
+
+            mode = pairing_chain.fused_tower_mode()
+            self._bill_chain(mode, rbits.shape[0])
+            return _jitted_rlc_dec(mode)(D_jac, PK_jac, rbits, H, W)
 
         cont = self._grouped_rlc(
             rlc_groups, items, build, jitted, results, direct,

@@ -397,14 +397,26 @@ def pairing(P, Qa):
     return final_exponentiation(miller_loop(P, Qa))
 
 
-def product2_fast(P1, Q1, P2, Q2):
+def product2_fast(P1, Q1, P2, Q2, fused=None):
     """THE verification kernel: FE_fast(ML(P1,Q1)·ML(P2,Q2)) as fq12 limbs.
 
     Single definition shared by the backend, the bench, the graft entry and
     the mesh-sharded path, so they always measure/compile the same graph.
     Host-compare each item against 1 (`is_one_host`) to decide
     e(P1,Q1)·e(P2,Q2) == 1.
+
+    ``fused`` routes the graph onto the VMEM-resident fused tower kernels
+    (ops/pairing_chain.py) — ``None`` consults the env ladder at TRACE
+    time (jit callers that must react to env flips key their caches on
+    the resolved mode, see TpuBackend), ``False`` forces the stacked
+    graph, an explicit "native"/"interpret" wins.  Both graphs compute
+    identical represented values.
     """
+    from hbbft_tpu.ops import pairing_chain
+
+    mode = pairing_chain.resolve_mode(fused)
+    if mode:
+        return pairing_chain.product2_fast_fused(P1, Q1, P2, Q2, mode=mode)
     return final_exponentiation_fast(miller_product([(P1, Q1), (P2, Q2)]))
 
 

@@ -1,0 +1,222 @@
+"""Fused pairing chain: the verification graph on VMEM-resident kernels.
+
+This is the orchestration layer over ops/tower_fused.py — the Miller
+loop, product merge and final exponentiation of ops/pairing.py rebuilt so
+that the heavy tower arithmetic runs inside the fused kernels:
+
+* `miller_loop_fused`: ONE `lax.scan` over the 63 bits of |x| whose body
+  launches the fused double-step kernel (all four stacked rounds of
+  `pairing._miller_double_step` in one VMEM-resident pass) on a ROW-layout
+  carry (f (960, W), R (480, W)).  The rare addition step (5 of 63 bits)
+  stays the existing stacked-XLA path behind a `lax.cond` — it converts
+  rows→lanes, runs `pairing._miller_add_step`, and converts back, so it
+  only *executes* on set bits.
+* `final_exponentiation_fast_fused`: the easy part stays on the stacked
+  path (it needs the Fermat inverse), the whole hard part is ONE
+  `tower_fused.hard_exp` launch (the 5·63-step x-chain register loop).
+* `miller_product_fused` / `product2_fast_fused`: same merge policy and
+  A/B switches as the unfused `miller_product` (HBBFT_TPU_NO_MERGE,
+  rank/batch fallbacks); cross-pair merge multiplies ride the fused
+  fq12_mul kernel.
+
+Every kernel reuses the exact recombination code of ops/tower.py and the
+`fq_rns_pallas` Montgomery core, so represented values are identical to
+the unfused graph (the tests assert bit-for-bit equality on canonical
+readback) — the kill switch HBBFT_TPU_NO_FUSED_TOWER restores the
+unfused graphs exactly.
+
+Analytic dispatch model (counter-asserted in tests): per merged 2-pair
+verification graph the stacked composition launches one Pallas multiply
+per stacked round —
+
+    63 doubles × 4 rounds + 5 adds × 11 rounds     = 307   (Miller)
+    1 cross-pair merge                             = 1
+    ~12 rounds easy part                           = 12
+    5 chains × (63×2 rounds/sqr + ~6 set-bit muls) = 660   (hard part)
+
+while the fused chain launches 63 double-step kernels + the same 55
+add rounds + 1 merge + the same ~12 easy rounds + ONE hard-part kernel —
+a ≥3× drop in per-verification device dispatches (measured ≈7×).
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from hbbft_tpu.crypto.bls381 import BLS_X_IS_NEG
+from hbbft_tpu.ops import pairing, tower
+from hbbft_tpu.ops import tower_fused as tf
+from hbbft_tpu.ops.tower_fused import fused_tower_mode  # noqa: F401  (re-export)
+
+
+def resolve_mode(fused=None):
+    """Normalize a per-call routing override to None|"native"|"interpret".
+
+    ``None`` consults the env ladder (`tower_fused.fused_tower_mode`);
+    ``False`` forces the unfused graph; an explicit mode string wins."""
+    if fused is False:
+        return None
+    if isinstance(fused, str):
+        return fused
+    return fused_tower_mode()
+
+
+# ---------------------------------------------------------------------------
+# Analytic dispatch/throughput model (see module docstring for derivation)
+# ---------------------------------------------------------------------------
+
+_N_BITS = len(pairing._X_BITS)  # 63
+_N_ADDS = sum(pairing._X_BITS)  # 5 set bits of |x| below the MSB
+_DBL_ROUNDS = 4  # pairing._miller_double_step stacked multiplies
+_ADD_ROUNDS = 11  # _line_add 5 + jac_add 5 + fq12_mul_line 1
+_EASY_ROUNDS = 12  # conj-free: inv (~10 stacked rounds) + frob² + mul
+_CHAIN_ROUNDS = 5 * (2 * _N_BITS + 6)  # 5 chains; cyclo sqr = mul+reduce
+_HARD_GLUE_ROUNDS = 10  # b/y3/y1/y0 muls, m3, 3 frobenius, 2 final muls
+
+#: Fq multiplies inside one fused double-step launch (48+18+7+45 lanes).
+DBL_FIELD_MULS = 118
+#: Fq multiplies inside the fused hard-part kernel: 5·63 loop steps of
+#: cyclo-sqr (18+12 reduce) + branch-free blend multiply (54), 5 boundary
+#: glue multiplies, and the m3/y0/frobenius/final tail.
+HARD_FIELD_MULS = 5 * _N_BITS * (30 + 54) + 5 * 54 + 84 + 54 + 54 + 108 + 54
+
+
+def analytic_pallas_calls(n_pairs: int = 2, fused: bool = False) -> int:
+    """Device kernel launches per verification graph (merged Miller)."""
+    shared = _N_ADDS * _ADD_ROUNDS + (n_pairs - 1) + _EASY_ROUNDS
+    if fused:
+        return _N_BITS + shared + 1  # dbl launches + add/easy/merge + hard
+    return _N_BITS * _DBL_ROUNDS + shared + _CHAIN_ROUNDS + _HARD_GLUE_ROUNDS
+
+
+def analytic_chain_field_muls(n_items: int, n_pairs: int = 2) -> int:
+    """Fq multiplies executed INSIDE the fused kernels for ``n_items``
+    verifications — the numerator of the fused-chain muls/s metric."""
+    per_item = n_pairs * _N_BITS * DBL_FIELD_MULS + (n_pairs - 1) * 54
+    return n_items * (per_item + HARD_FIELD_MULS)
+
+
+# ---------------------------------------------------------------------------
+# Fused Miller loop
+# ---------------------------------------------------------------------------
+
+
+def miller_loop_fused(P, Qa, mode: str = "native"):
+    """`pairing.miller_loop` with the doubling step on the fused kernel.
+
+    The scan carry lives in kernel ROW layout (f (960, W), R (480, W)) so
+    the dominant path — 63 doubling steps — is one kernel launch per bit
+    with no relayout; only the 5 set-bit addition steps convert to lane
+    layout for the existing stacked `_miller_add_step` and back."""
+    interpret = mode == "interpret"
+    xP, yP, infP = P
+    xQ, yQ, infQ = Qa
+    shape = jnp.asarray(xP).shape
+    batch_shape = shape[:-1]
+    lanes = int(np.prod(batch_shape)) if batch_shape else 1
+    width = tf._n_tiles(lanes, tf.TILE) * tf.TILE
+
+    one2 = tower.fq2_broadcast(tower.FQ2_ONE, batch_shape)
+    inf0 = jnp.zeros(batch_shape, dtype=bool)
+    Qj = (xQ, yQ, one2, inf0)
+
+    def pack(el):
+        return jnp.concatenate(
+            [tf._to_rows(c, lanes, width) for c in tf._leaves(el)], axis=0
+        )
+
+    def unpack_f(fr):
+        return tf._unpack_element(fr, 12, lanes, shape)
+
+    def unpack_R(rr):
+        c = [
+            tf._from_rows(x, lanes).reshape(shape)
+            for x in tf._unpack_rows(rr, 6)
+        ]
+        return ((c[0], c[1]), (c[2], c[3]), (c[4], c[5]), inf0)
+
+    p_rows = pack((jnp.asarray(xP), jnp.asarray(yP)))
+    f_rows = pack(tower.fq12_broadcast_one(batch_shape))
+    r_rows = pack(((xQ, yQ), one2))
+    bits = jnp.asarray(pairing._X_BITS, dtype=jnp.bool_)
+
+    def body(carry, bit):
+        fr, rr = carry
+        fr, rr = tf.miller_double_step_rows(fr, rr, p_rows, interpret)
+
+        def add(c):
+            f, Rj = unpack_f(c[0]), unpack_R(c[1])
+            f2, R2 = pairing._miller_add_step(f, Rj, Qa, Qj, xP, yP)
+            return pack(f2), pack((R2[0], R2[1], R2[2]))
+
+        fr, rr = jax.lax.cond(bit, add, lambda c: c, (fr, rr))
+        return (fr, rr), None
+
+    (f_rows, _), _ = jax.lax.scan(body, (f_rows, r_rows), bits)
+    f = unpack_f(f_rows)
+    if BLS_X_IS_NEG:
+        f = tower.fq12_conj(f)
+    neutral = infP | infQ
+    return tower.fq12_select(
+        neutral, tower.fq12_broadcast_one(batch_shape), f
+    )
+
+
+def miller_product_fused(pairs, mode: str = "native"):
+    """`pairing.miller_product` on the fused loop — same merge policy
+    (stack along the leading axis when every pair is batched with one
+    common batch size; HBBFT_TPU_NO_MERGE and rank mismatches fall back
+    to sequential loops), cross-pair merges on the fused fq12_mul."""
+    interpret = mode == "interpret"
+    if len(pairs) == 1:
+        return miller_loop_fused(*pairs[0], mode=mode)
+
+    ranks = {jnp.ndim(p[0][0]) for p in pairs}
+    batches = {jnp.shape(p[0][0])[0] for p in pairs}
+    if (
+        ranks != {2}
+        or len(batches) != 1
+        or os.environ.get("HBBFT_TPU_NO_MERGE")
+    ):
+        f = None
+        for P, Qa in pairs:
+            fk = miller_loop_fused(P, Qa, mode=mode)
+            f = fk if f is None else tf.fq12_mul(f, fk, interpret=interpret)
+        return f
+
+    def cat(leaves):
+        return jnp.concatenate([jnp.asarray(c) for c in leaves], axis=0)
+
+    P = jax.tree_util.tree_map(lambda *cs: cat(cs), *[p for p, _ in pairs])
+    Qa = jax.tree_util.tree_map(lambda *cs: cat(cs), *[q for _, q in pairs])
+    f_all = miller_loop_fused(P, Qa, mode=mode)
+    batch = jnp.shape(pairs[0][0][0])[0]
+    parts = [
+        jax.tree_util.tree_map(lambda c: c[i * batch : (i + 1) * batch], f_all)
+        for i in range(len(pairs))
+    ]
+    f = parts[0]
+    for fk in parts[1:]:
+        f = tf.fq12_mul(f, fk, interpret=interpret)
+    return f
+
+
+def final_exponentiation_fast_fused(f, mode: str = "native"):
+    """`pairing.final_exponentiation_fast` with the hard part as ONE
+    kernel launch.  The easy part stays stacked (it needs the Fermat
+    inverse, which already rides the round-2 fused pow kernel)."""
+    m = tower.fq12_mul(tower.fq12_conj(f), tower.fq12_inv(f))
+    m = tower.fq12_mul(tower.fq12_frobenius_n(m, 2), m)
+    return tf.hard_exp(m, interpret=(mode == "interpret"))
+
+
+def product2_fast_fused(P1, Q1, P2, Q2, mode: str = "native"):
+    """Fused-chain `pairing.product2_fast` — same represented values."""
+    return final_exponentiation_fast_fused(
+        miller_product_fused([(P1, Q1), (P2, Q2)], mode=mode), mode=mode
+    )

@@ -1,0 +1,733 @@
+"""VMEM-resident fused tower kernels: fq2/fq6/fq12 ops on the RNS core.
+
+Mid-granularity fusion — the level between the two approaches that
+already failed on-chip (per-step fused LIMB kernels lost 1.4-2.6x to
+Mosaic scheduling in round 2; FUSE2 whole-loop kernels never compiled):
+each kernel here fuses ONE tower-level operation (an Fq12 multiply, a
+Miller double step, the whole final-exp hard part) so the ~54 Fq muls
+inside one Fq12 multiply never leave VMEM, while the loop structure
+around the kernels stays ordinary XLA (`lax.scan` at the pairing layer).
+
+Building blocks:
+
+* The multiply body is `fq_rns_pallas._mul_core` — the already-golden
+  full-RNS Montgomery pipeline — reused VERBATIM on (80, T) row tiles.
+* A tower operation's n independent Fq products run as ONE core pass by
+  CONCATENATING the operands along the lane axis (scatter-free — the
+  FUSE2 lesson: `_kmul`); the recombination arithmetic is the exact
+  pointwise code from ops/tower.py (`fq2_from_products`,
+  `fq6_from_products`, ...), which only uses lazy adds/subs/negs and so
+  runs unchanged on row-layout tiles.  Because the recombination is the
+  SAME code and the core is stage-identical to `fq_rns.mul`, the fused
+  kernels compute the same represented values as the stacked
+  composition — the golden tests assert that equality bit-for-bit on
+  canonical readback.
+* `reduce_small` (a full Montgomery multiply by ONE in the RNS
+  representation — value renormalization, see fq_rns.reduce_small) is
+  mirrored in-kernel as a core pass against a broadcast ONE column, so
+  the cyclotomic-squaring chain has the identical value flow to
+  tower.fq12_cyclo_sqr.
+
+Layout: a tower element with C Fq coefficients is ONE (C·80, T) f32
+array — coefficient c occupies rows [80c, 80c+80) in the padded kernel
+row layout of fq_rns_pallas ([B1(39) | pad | B2(39) | m_r]); 80 rows =
+10 sublanes, so every coefficient slice is sublane-aligned.  Leaf order
+matches tower.fq12_to_ints_batch: for fq6-half s, fq2-coeff t, component
+c — index 4s + 2t + c... i.e. ``[c for x6 in a for x2 in x6 for c in x2]``.
+
+Tiling: TILE lanes per grid step (HBBFT_TPU_TOWER_TILE, default 128 —
+the f32 lane minimum).  The widest internal concatenation is 54·TILE
+lanes (an Fq12 multiply); at TILE=128 the peak live set of a core pass
+is ~12-15 MB of VMEM, inside the ~16 MB/core budget but with little
+slack — raising TILE trades grid overhead against Mosaic spilling, which
+is exactly what the `fused_chain_ab` window step measures on-chip.
+
+Routing (`fused_tower_mode`): the fallback ladder is
+fused → HBBFT_TPU_NO_FUSED → HBBFT_TPU_NO_PALLAS, with the per-call kill
+switch HBBFT_TPU_NO_FUSED_TOWER disabling ONLY these tower kernels
+(leaving the round-2 pow kernel routing untouched).
+HBBFT_TPU_FUSED_TOWER=interpret forces interpret-mode routing (the CPU
+A/B arm used by the tests); =auto (default) routes natively on TPU only.
+Requires the RNS field implementation (fq.IMPL == "rns"); the limb
+facade never routes here.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from hbbft_tpu.crypto.bls381 import BLS_X, BLS_X_IS_NEG
+from hbbft_tpu.ops import fq
+from hbbft_tpu.ops import fq_rns as R
+from hbbft_tpu.ops import fq_rns_pallas as K
+from hbbft_tpu.ops import tower
+
+#: lanes per grid step.  Module attribute (not captured) so tests can
+#: shrink it for cheap interpret-mode runs; every cached pallas_call is
+#: keyed on the tile it was built with.
+TILE = int(os.environ.get("HBBFT_TPU_TOWER_TILE", "128"))
+assert TILE > 0, f"HBBFT_TPU_TOWER_TILE={TILE} must be positive"
+
+NROWS = K.NROWS  # 80
+_NB = R.N_B  # 39
+DTYPE = K.DTYPE
+
+#: final-exp x-chain bit schedule (MSB implicit — acc starts at the base,
+#: mirroring tower.fq12_cyclo_pow_segmented's bin(x)[3:]).
+_X_CHAIN_BITS = np.array([int(b) for b in bin(BLS_X)[3:]], dtype=np.int32)
+
+
+def fused_tower_mode():
+    """None (off) | "native" | "interpret" — read per call, never cached.
+
+    Ladder position: fused → HBBFT_TPU_NO_FUSED → HBBFT_TPU_NO_PALLAS
+    (either generic switch disables this layer too), plus the dedicated
+    per-call kill switch HBBFT_TPU_NO_FUSED_TOWER."""
+    if fq.IMPL != "rns":
+        return None
+    if os.environ.get("HBBFT_TPU_NO_PALLAS"):
+        return None
+    if os.environ.get("HBBFT_TPU_NO_FUSED"):
+        return None
+    if os.environ.get("HBBFT_TPU_NO_FUSED_TOWER"):
+        return None
+    v = os.environ.get("HBBFT_TPU_FUSED_TOWER", "auto")
+    if v in ("0", "off"):
+        return None
+    if v == "interpret":
+        return "interpret"
+    if v in ("1", "native"):
+        return "native"
+    return "native" if jax.default_backend() == "tpu" else None
+
+
+# ---------------------------------------------------------------------------
+# Constants in kernel layout
+# ---------------------------------------------------------------------------
+
+#: packed tower constants (80, 40): col 0 = ONE (the reduce_small
+#: multiplier), cols 1+12(n−1)..12n = the Frobenius^n fq2 coefficient
+#: sets for n = 1, 2, 3 (component c of K^{(n)}[j][i] at column
+#: 1 + 12(n−1) + 2(3j+i) + c).  K^{(n)} = conj(K^{(n−1)})·K^{(1)} —
+#: frob^n(a)_ji = conj^n(a_ji)·K^{(n)}_ji, so each frob^n application is
+#: ONE 6-fq2 constant round instead of n chained applications.
+_NTC = 40
+
+
+def _const_col(res79) -> np.ndarray:
+    """(79,) RNS residues → padded (80,) kernel row column."""
+    v = np.array(res79, dtype=np.float32).reshape(-1)
+    return np.concatenate([v[:_NB], np.zeros(1, np.float32), v[_NB:]])
+
+
+@functools.lru_cache(maxsize=None)
+def _tower_consts() -> np.ndarray:
+    # host-golden Frobenius fq2 constants, converted on the RNS path
+    # explicitly (the tower module's copies follow the fq facade, which
+    # may be bound to the limb impl)
+    from hbbft_tpu.crypto import bls381 as gold
+
+    c = np.zeros((NROWS, _NTC), dtype=np.float32)
+    c[:, 0] = _const_col(R.ONE)
+    k1 = [
+        [
+            gold.fq2_mul(
+                tower._gold_fq2_pow(tower._C3_INT, i),
+                tower._gold_fq2_pow(tower._C6_INT, j),
+            )
+            for i in range(3)
+        ]
+        for j in range(2)
+    ]
+    kn = k1
+    for n in (1, 2, 3):
+        for j in range(2):
+            for i in range(3):
+                col = 1 + 12 * (n - 1) + 2 * (3 * j + i)
+                c[:, col] = _const_col(R.from_int(kn[j][i][0]))
+                c[:, col + 1] = _const_col(R.from_int(kn[j][i][1]))
+        kn = [
+            [
+                gold.fq2_mul(gold.fq2_conj(kn[j][i]), k1[j][i])
+                for i in range(3)
+            ]
+            for j in range(2)
+        ]
+    return c
+
+
+# ---------------------------------------------------------------------------
+# Layout: lanes (..., 79) <-> kernel rows (80, T); element pack/unpack
+# ---------------------------------------------------------------------------
+
+
+def _n_tiles(lanes: int, tile: int) -> int:
+    return max(1, -(-lanes // tile))
+
+
+def _to_rows(x: jnp.ndarray, lanes: int, width: int) -> jnp.ndarray:
+    """(..., 79) → padded kernel layout (80, width)."""
+    flat = jnp.asarray(x, DTYPE).reshape(lanes, R.NLIMBS).T
+    z = jnp.zeros((1, lanes), dtype=DTYPE)
+    rows = jnp.concatenate([flat[:_NB], z, flat[_NB:]], axis=0)
+    pad = width - lanes
+    return jnp.pad(rows, ((0, 0), (0, pad))) if pad else rows
+
+
+def _from_rows(r: jnp.ndarray, lanes: int) -> jnp.ndarray:
+    """(80, T) kernel rows → (lanes, 79)."""
+    body = jnp.concatenate([r[:_NB, :lanes], r[40:, :lanes]], axis=0)
+    return body.T
+
+
+def _leaves(el) -> list:
+    """Flatten an fq2/fq6/fq12 pytree into its Fq coefficient list, in
+    the canonical order (matches tower.fq12_to_ints_batch for fq12)."""
+    out = []
+
+    def walk(x):
+        if isinstance(x, tuple):
+            for y in x:
+                walk(y)
+        else:
+            out.append(x)
+
+    walk(el)
+    return out
+
+
+def _fq2_of(rows):
+    return (rows[0], rows[1])
+
+
+def _fq6_of(rows):
+    return ((rows[0], rows[1]), (rows[2], rows[3]), (rows[4], rows[5]))
+
+
+def _fq12_of(rows):
+    return (_fq6_of(rows[0:6]), _fq6_of(rows[6:12]))
+
+
+_OF = {2: _fq2_of, 6: _fq6_of, 12: _fq12_of}
+
+
+def _unpack_rows(r: jnp.ndarray, n: int) -> list:
+    return [r[NROWS * i : NROWS * (i + 1)] for i in range(n)]
+
+
+def _pack_element(el, lanes: int, width: int) -> jnp.ndarray:
+    return jnp.concatenate(
+        [_to_rows(c, lanes, width) for c in _leaves(el)], axis=0
+    )
+
+
+def _unpack_element(r: jnp.ndarray, n: int, lanes: int, shape) -> tuple:
+    rows = _unpack_rows(r, n)
+    return _OF[n]([_from_rows(c, lanes).reshape(shape) for c in rows])
+
+
+# ---------------------------------------------------------------------------
+# In-kernel building blocks
+# ---------------------------------------------------------------------------
+
+
+def _kmul(pairs, em, cm) -> list:
+    """n independent Fq products in ONE `_mul_core` pass.
+
+    Operands are CONCATENATED along the lane axis (one contiguous core
+    call, no scatters — the FUSE2 lesson), multiplied, and sliced back.
+    `reduced=False`: the core renormalizes its own input lanes, exactly
+    as fq_rns.mul does for the stacked path — identical value flow."""
+    a = jnp.concatenate([p[0] for p in pairs], axis=1)
+    b = jnp.concatenate([p[1] for p in pairs], axis=1)
+    out = K._mul_core(a, b, em, cm, reduced=False)
+    t = pairs[0][0].shape[1]
+    return [out[:, i * t : (i + 1) * t] for i in range(len(pairs))]
+
+
+def _kmul2(pairs2, em, cm) -> list:
+    """n independent fq2 products (Karatsuba, 3 Fq lanes each) in one
+    core pass — the in-kernel tower.fq2_mul_many."""
+    flat = []
+    for a, b in pairs2:
+        flat.extend(tower.fq2_mul_pairs(a, b))
+    res = _kmul(flat, em, cm)
+    return [
+        tower.fq2_from_products(res[3 * i : 3 * i + 3])
+        for i in range(len(pairs2))
+    ]
+
+
+def _reduce12(coeffs, tc, em, cm) -> list:
+    """In-kernel fq.reduce_small over 6 fq2 coefficients: one Montgomery
+    pass against the broadcast ONE column (value renormalization — same
+    represented values as the stacked reduce_small, which is mul by ONE)."""
+    arrs = [c for pair in coeffs for c in pair]
+    cat = jnp.concatenate(arrs, axis=1)
+    one = jnp.broadcast_to(tc[:, 0:1], cat.shape)
+    red = K._mul_core(cat, one, em, cm, reduced=False)
+    t = arrs[0].shape[1]
+    out = [red[:, i * t : (i + 1) * t] for i in range(12)]
+    return [(out[2 * i], out[2 * i + 1]) for i in range(6)]
+
+
+def _fq12_mul_r(a, b, em, cm):
+    """In-kernel tower.fq12_mul — 18 fq2 (54 Fq) products, one core pass."""
+    return _fq12_mul_many_r([(a, b)], em, cm)[0]
+
+
+def _fq12_sqr_r(a, em, cm):
+    return tower.fq12_sqr_from_products(
+        _kmul2(tower.fq12_sqr_pairs(a), em, cm)
+    )
+
+
+def _cyclo_sqr_r(a, tc, em, cm):
+    """In-kernel tower.fq12_cyclo_sqr (Granger–Scott), 18 squaring lanes
+    + the 12-lane value renormalization, two core passes — the chain
+    step that keeps the whole x-power state in VMEM."""
+    (a0, a1, a2), (b0, b1, b2) = a
+    flat = []
+    for x, y in ((a0, b1), (a1, b2), (a2, b0)):
+        flat.extend(tower.fq2_sqr_pairs(x))
+        flat.extend(tower.fq2_sqr_pairs(y))
+        flat.extend(tower.fq2_sqr_pairs(tower.fq2_add(x, y)))
+    res = _kmul(flat, em, cm)
+    sq = [tower.fq2_sqr_from_products(res[2 * i : 2 * i + 2]) for i in range(9)]
+    (x0s, y0s, s0s), (x1s, y1s, s1s), (x2s, y2s, s2s) = (
+        sq[0:3],
+        sq[3:6],
+        sq[6:9],
+    )
+
+    def three(t):
+        return tower.fq2_add(tower.fq2_add(t, t), t)
+
+    def two(t):
+        return tower.fq2_add(t, t)
+
+    xy0 = tower.fq2_sub(tower.fq2_sub(s0s, x0s), y0s)
+    xy1 = tower.fq2_sub(tower.fq2_sub(s1s, x1s), y1s)
+    xy2 = tower.fq2_sub(tower.fq2_sub(s2s, x2s), y2s)
+
+    s_a0 = tower.fq2_sub(three(tower.fq2_add(x0s, tower.fq2_mul_xi(y0s))), two(a0))
+    s_b1 = tower.fq2_add(three(xy0), two(b1))
+    s_a2 = tower.fq2_sub(three(tower.fq2_add(x1s, tower.fq2_mul_xi(y1s))), two(a2))
+    s_b0 = tower.fq2_add(tower.fq2_mul_xi(three(xy1)), two(b0))
+    s_a1 = tower.fq2_sub(three(tower.fq2_add(tower.fq2_mul_xi(x2s), y2s)), two(a1))
+    s_b2 = tower.fq2_add(three(xy2), two(b2))
+
+    out = _reduce12([s_a0, s_a1, s_a2, s_b0, s_b1, s_b2], tc, em, cm)
+    return ((out[0], out[1], out[2]), (out[3], out[4], out[5]))
+
+
+def _fq12_mul_many_r(ab_list, em, cm) -> list:
+    """k independent fq12 products (18 fq2 pairs each) in ONE core pass."""
+    flat = []
+    for a, b in ab_list:
+        a0, a1 = a
+        b0, b1 = b
+        flat += (
+            tower.fq6_mul_fq2_pairs(a0, b0)
+            + tower.fq6_mul_fq2_pairs(a1, b1)
+            + tower.fq6_mul_fq2_pairs(
+                tower.fq6_add(a0, a1), tower.fq6_add(b0, b1)
+            )
+        )
+    res = _kmul2(flat, em, cm)
+    outs = []
+    for idx in range(len(ab_list)):
+        r = res[18 * idx : 18 * idx + 18]
+        t0 = tower.fq6_from_products(r[0:6])
+        t1 = tower.fq6_from_products(r[6:12])
+        mid = tower.fq6_from_products(r[12:18])
+        c0 = tower.fq6_add(t0, tower.fq6_mul_by_v(t1))
+        c1 = tower.fq6_sub(mid, tower.fq6_add(t0, t1))
+        outs.append((c0, c1))
+    return outs
+
+
+def _frob3_r(y1, y2, y3, tc, em, cm):
+    """frob(y1), frob²(y2), frob³(y3) in ONE 18-fq2 core round.
+
+    Uses the host-precomputed K^{(n)} constant sets (frob^n(a)_ji =
+    conj^n(a_ji)·K^{(n)}_ji), so a power-n Frobenius costs the same one
+    round as a single application instead of n chained ones."""
+    t = y1[0][0][0].shape[1]
+    pairs = []
+    for n, a in ((1, y1), (2, y2), (3, y3)):
+        off = 1 + 12 * (n - 1)
+        for j in range(2):
+            for i in range(3):
+                col = off + 2 * (3 * j + i)
+                kc = (
+                    jnp.broadcast_to(tc[:, col : col + 1], (NROWS, t)),
+                    jnp.broadcast_to(tc[:, col + 1 : col + 2], (NROWS, t)),
+                )
+                aji = tower.fq2_conj(a[j][i]) if n % 2 else a[j][i]
+                pairs.append((aji, kc))
+    res = _kmul2(pairs, em, cm)
+
+    def f12(r):
+        return ((r[0], r[1], r[2]), (r[3], r[4], r[5]))
+
+    return f12(res[0:6]), f12(res[6:12]), f12(res[12:18])
+
+
+# ---------------------------------------------------------------------------
+# Kernel: single tower operation (fq2/fq6/fq12 multiply and square)
+# ---------------------------------------------------------------------------
+
+#: kind → (coefficient count, body builder on row pytrees)
+_OP_BODY = {
+    "fq2_mul": (2, lambda a, b, tc, em, cm: _kmul2([(a, b)], em, cm)[0]),
+    "fq2_sqr": (
+        2,
+        lambda a, b, tc, em, cm: tower.fq2_sqr_from_products(
+            _kmul(tower.fq2_sqr_pairs(a), em, cm)
+        ),
+    ),
+    "fq6_mul": (
+        6,
+        lambda a, b, tc, em, cm: tower.fq6_from_products(
+            _kmul2(tower.fq6_mul_fq2_pairs(a, b), em, cm)
+        ),
+    ),
+    "fq6_sqr": (
+        6,
+        # tower.fq6_sqr IS fq6_mul(a, a) — mirror it exactly
+        lambda a, b, tc, em, cm: tower.fq6_from_products(
+            _kmul2(tower.fq6_mul_fq2_pairs(a, a), em, cm)
+        ),
+    ),
+    "fq12_mul": (12, lambda a, b, tc, em, cm: _fq12_mul_r(a, b, em, cm)),
+    "fq12_sqr": (12, lambda a, b, tc, em, cm: _fq12_sqr_r(a, em, cm)),
+    "fq12_cyclo_sqr": (
+        12,
+        lambda a, b, tc, em, cm: _cyclo_sqr_r(a, tc, em, cm),
+    ),
+}
+
+
+def _op_kernel(a_ref, b_ref, em_ref, cm_ref, tc_ref, out_ref, *, kind: str):
+    n, body = _OP_BODY[kind]
+    em, cm, tc = em_ref[:], cm_ref[:], tc_ref[:]
+    a = _OF[n](_unpack_rows(a_ref[:], n))
+    b = _OF[n](_unpack_rows(b_ref[:], n))
+    out = body(a, b, tc, em, cm)
+    out_ref[:] = jnp.concatenate(_leaves(out), axis=0)
+
+
+@functools.lru_cache(maxsize=None)
+def _op_call(kind: str, n_tiles: int, tile: int, interpret: bool):
+    n, _ = _OP_BODY[kind]
+    rows = n * NROWS
+    return pl.pallas_call(
+        functools.partial(_op_kernel, kind=kind),
+        out_shape=jax.ShapeDtypeStruct((rows, n_tiles * tile), DTYPE),
+        grid=(n_tiles,),
+        in_specs=[
+            pl.BlockSpec((rows, tile), lambda i: (0, i)),
+            pl.BlockSpec((rows, tile), lambda i: (0, i)),
+            pl.BlockSpec((NROWS, NROWS), lambda i: (0, 0)),
+            pl.BlockSpec((NROWS, K._NCONST), lambda i: (0, 0)),
+            pl.BlockSpec((NROWS, _NTC), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((rows, tile), lambda i: (0, i)),
+        interpret=interpret,
+    )
+
+
+def _tower_op(kind: str, a, b, interpret: bool):
+    n, _ = _OP_BODY[kind]
+    leaves = _leaves(a)
+    shape = jnp.broadcast_shapes(*(jnp.shape(c) for c in _leaves((a, b))))
+    batch = shape[:-1]
+    lanes = int(np.prod(batch)) if batch else 1
+    nt = _n_tiles(lanes, TILE)
+    width = nt * TILE
+
+    def pack(el):
+        return jnp.concatenate(
+            [
+                _to_rows(jnp.broadcast_to(jnp.asarray(c, DTYPE), shape), lanes, width)
+                for c in _leaves(el)
+            ],
+            axis=0,
+        )
+
+    out = _op_call(kind, nt, TILE, interpret)(
+        pack(a),
+        pack(b),
+        jnp.asarray(K._EMAT),
+        jnp.asarray(K._CONSTS),
+        jnp.asarray(_tower_consts()),
+    )
+    del leaves
+    return _unpack_element(out, n, lanes, shape)
+
+
+def fq2_mul(a, b, interpret: bool = False):
+    """Fused tower.fq2_mul — one kernel, 3 Fq lanes resident in VMEM."""
+    return _tower_op("fq2_mul", a, b, interpret)
+
+
+def fq2_sqr(a, interpret: bool = False):
+    return _tower_op("fq2_sqr", a, a, interpret)
+
+
+def fq6_mul(a, b, interpret: bool = False):
+    """Fused tower.fq6_mul — 18 Fq lanes in one VMEM-resident pass."""
+    return _tower_op("fq6_mul", a, b, interpret)
+
+
+def fq6_sqr(a, interpret: bool = False):
+    return _tower_op("fq6_sqr", a, a, interpret)
+
+
+def fq12_mul(a, b, interpret: bool = False):
+    """Fused tower.fq12_mul — the ~54 Fq muls never leave VMEM."""
+    return _tower_op("fq12_mul", a, b, interpret)
+
+
+def fq12_sqr(a, interpret: bool = False):
+    return _tower_op("fq12_sqr", a, a, interpret)
+
+
+def fq12_cyclo_sqr(a, interpret: bool = False):
+    """Fused Granger–Scott cyclotomic squaring (incl. the reduce pass)."""
+    return _tower_op("fq12_cyclo_sqr", a, a, interpret)
+
+
+# ---------------------------------------------------------------------------
+# Kernel: Miller double step (scatter-free concatenate form)
+# ---------------------------------------------------------------------------
+
+
+def _dbl_kernel(f_ref, r_ref, p_ref, em_ref, cm_ref, fout_ref, rout_ref):
+    """One Miller doubling — f ← f²·l(R), R ← 2R — with all four stacked
+    rounds of pairing._miller_double_step (48 + 18 + 7 + 45 Fq lanes)
+    fused into one VMEM-resident kernel.  The recombination between the
+    rounds is the exact code from pairing.py, run on row tiles."""
+    em, cm = em_ref[:], cm_ref[:]
+    f = _fq12_of(_unpack_rows(f_ref[:], 12))
+    rr = _unpack_rows(r_ref[:], 6)
+    X, Y, Z = (rr[0], rr[1]), (rr[2], rr[3]), (rr[4], rr[5])
+    pp = _unpack_rows(p_ref[:], 2)
+    xP, yP = pp[0], pp[1]
+
+    res = _kmul2(
+        tower.fq12_sqr_pairs(f) + [(X, X), (Y, Y), (Z, Z), (Y, Z)], em, cm
+    )
+    f2 = tower.fq12_sqr_from_products(res[:12])
+    XX, YY, ZZ, YZ = res[12:]
+    E = tower.fq2_add(tower.fq2_add(XX, XX), XX)
+    XpYY = tower.fq2_add(X, YY)
+    XXX, XXZZ, YZ3, C, T, Fv = _kmul2(
+        [(XX, X), (XX, ZZ), (YZ, ZZ), (YY, YY), (XpYY, XpYY), (E, E)], em, cm
+    )
+    D = tower.fq2_sub(tower.fq2_sub(T, XX), C)
+    D = tower.fq2_add(D, D)
+    X3 = tower.fq2_sub(Fv, tower.fq2_add(D, D))
+    C4 = tower.fq2_add(tower.fq2_add(C, C), tower.fq2_add(C, C))
+    C8 = tower.fq2_add(C4, C4)
+
+    c1a1 = tower.fq2_sub(
+        tower.fq2_add(tower.fq2_add(XXX, XXX), XXX), tower.fq2_add(YY, YY)
+    )
+    u = tower.fq2_mul_xi(tower.fq2_add(YZ3, YZ3))
+    v = tower.fq2_add(tower.fq2_add(XXZZ, XXZZ), XXZZ)
+
+    DmX3 = tower.fq2_sub(D, X3)
+    prods = _kmul(
+        tower.fq2_mul_pairs(E, DmX3)
+        + [(u[0], yP), (u[1], yP), (v[0], xP), (v[1], xP)],
+        em,
+        cm,
+    )
+    EDX3 = tower.fq2_from_products(prods[:3])
+    c0a0 = (prods[3], prods[4])
+    c1a2 = (fq.neg(prods[5]), fq.neg(prods[6]))
+
+    Y3 = tower.fq2_sub(EDX3, C8)
+    Z3p = tower.fq2_add(YZ, YZ)
+
+    res4 = _kmul2(tower.fq12_mul_line_pairs(f2, (c0a0, c1a1, c1a2)), em, cm)
+    f_new = tower.fq12_mul_line_from_products(res4)
+
+    fout_ref[:] = jnp.concatenate(_leaves(f_new), axis=0)
+    rout_ref[:] = jnp.concatenate(
+        [X3[0], X3[1], Y3[0], Y3[1], Z3p[0], Z3p[1]], axis=0
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def _dbl_call(n_tiles: int, tile: int, interpret: bool):
+    return pl.pallas_call(
+        _dbl_kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((12 * NROWS, n_tiles * tile), DTYPE),
+            jax.ShapeDtypeStruct((6 * NROWS, n_tiles * tile), DTYPE),
+        ),
+        grid=(n_tiles,),
+        in_specs=[
+            pl.BlockSpec((12 * NROWS, tile), lambda i: (0, i)),
+            pl.BlockSpec((6 * NROWS, tile), lambda i: (0, i)),
+            pl.BlockSpec((2 * NROWS, tile), lambda i: (0, i)),
+            pl.BlockSpec((NROWS, NROWS), lambda i: (0, 0)),
+            pl.BlockSpec((NROWS, K._NCONST), lambda i: (0, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((12 * NROWS, tile), lambda i: (0, i)),
+            pl.BlockSpec((6 * NROWS, tile), lambda i: (0, i)),
+        ),
+        interpret=interpret,
+    )
+
+
+def miller_double_step_rows(f_rows, r_rows, p_rows, interpret: bool = False):
+    """Row-layout Miller double step — ONE launch per scan iteration.
+
+    f_rows (960, T), r_rows (480, T) = [X0 X1 Y0 Y1 Z0 Z1],
+    p_rows (160, T) = [xP yP]; T must be a multiple of the build tile."""
+    width = f_rows.shape[1]
+    nt = width // TILE
+    assert nt * TILE == width, (width, TILE)
+    return _dbl_call(nt, TILE, interpret)(
+        f_rows, r_rows, p_rows, jnp.asarray(K._EMAT), jnp.asarray(K._CONSTS)
+    )
+
+
+# ---------------------------------------------------------------------------
+# Kernel: the final-exponentiation hard part (the x-chain)
+# ---------------------------------------------------------------------------
+
+
+def _sel12(cond, x, y):
+    """Scalar-predicated fq12 register select (pointwise where)."""
+    return jax.tree_util.tree_map(lambda u, v: jnp.where(cond, u, v), x, y)
+
+
+def _hard_kernel(bits_ref, m_ref, em_ref, cm_ref, tc_ref, out_ref):
+    """The ENTIRE final-exp hard part in one kernel, as ONE fori_loop.
+
+    The five x-power chains of pairing.final_exponentiation_fast run as a
+    single 5·nbits-step loop over a VMEM-resident register file
+    (acc, base, b, y3, y2, y1) — the round-15 scan discipline: one
+    compiled ~4-core-pass body, no unrolled chains.  Step s executes bit
+    s % nbits of chain s // nbits (squaring + where-blended multiply, the
+    proven _pow_kernel shape); at each chain boundary a lax.cond branch
+    performs the glue multiply (conj(chain)·operand — the operand is ONE
+    for the pure-power chains, so the register update is uniform) and
+    rotates the register file.  Every value renormalization is the same
+    Montgomery pass-by-ONE as the stacked path, so represented values are
+    identical; the trailing Frobenius glue uses the precomputed K^{(n)}
+    sets to finish in one round."""
+    em, cm, tc = em_ref[:], cm_ref[:], tc_ref[:]
+    m = _fq12_of(_unpack_rows(m_ref[:], 12))
+    nbits = bits_ref.shape[0]
+    t = m_ref.shape[1]
+    zero = jnp.zeros((NROWS, t), DTYPE)
+    one2 = (jnp.broadcast_to(tc[:, 0:1], (NROWS, t)), zero)
+    z2 = (zero, zero)
+    one12 = ((one2, z2, z2), (z2, z2, z2))
+
+    def body(s, regs):
+        acc, base, b, y3, y2, y1 = regs
+        i = s % nbits
+        k = s // nbits
+        sq = _cyclo_sqr_r(acc, tc, em, cm)
+        wm = _fq12_mul_r(sq, base, em, cm)
+        acc = _sel12(bits_ref[i] > 0, wm, sq)
+
+        def boundary(r):
+            acc2, _, b2, y32, y22, y12 = r
+            # chain result (BLS x is negative → conjugate), then the glue
+            # multiply: ·conj(m) after chain 0 (→b), ·conj(b) after
+            # chain 1 (→y3), ·conj(y3) after chain 3 (→y1); chains 2 and
+            # 4 are pure powers (→y2, →y0'), i.e. a multiply by ONE.
+            ca = tower.fq12_conj(acc2) if BLS_X_IS_NEG else acc2
+            op = _sel12(
+                k == 0,
+                tower.fq12_conj(m),
+                _sel12(
+                    k == 1,
+                    tower.fq12_conj(b2),
+                    _sel12(k == 3, tower.fq12_conj(y32), one12),
+                ),
+            )
+            val = _fq12_mul_r(ca, op, em, cm)
+            return (
+                val,
+                val,
+                _sel12(k == 0, val, b2),
+                _sel12(k == 1, val, y32),
+                _sel12(k == 2, val, y22),
+                _sel12(k == 3, val, y12),
+            )
+
+        return jax.lax.cond(
+            i == nbits - 1, boundary, lambda r: r, (acc, base, b, y3, y2, y1)
+        )
+
+    regs = jax.lax.fori_loop(0, 5 * nbits, body, (m, m, m, m, m, m))
+    y0p, _, _, y3, y2, y1 = regs
+    m3 = _fq12_mul_r(_cyclo_sqr_r(m, tc, em, cm), m, em, cm)
+    y0 = _fq12_mul_r(y0p, m3, em, cm)
+    f1, f2, f3 = _frob3_r(y1, y2, y3, tc, em, cm)
+    # ((y0·F1)·F2)·F3 regrouped as (y0·F1)·(F2·F3) — same field value,
+    # one fewer sequential round
+    u, v = _fq12_mul_many_r([(y0, f1), (f2, f3)], em, cm)
+    out = _fq12_mul_r(u, v, em, cm)
+    out_ref[:] = jnp.concatenate(_leaves(out), axis=0)
+
+
+@functools.lru_cache(maxsize=None)
+def _hard_call(n_tiles: int, tile: int, nbits: int, interpret: bool):
+    rows = 12 * NROWS
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n_tiles,),
+        in_specs=[
+            pl.BlockSpec((rows, tile), lambda i, *_: (0, i)),
+            pl.BlockSpec((NROWS, NROWS), lambda i, *_: (0, 0)),
+            pl.BlockSpec((NROWS, K._NCONST), lambda i, *_: (0, 0)),
+            pl.BlockSpec((NROWS, _NTC), lambda i, *_: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((rows, tile), lambda i, *_: (0, i)),
+    )
+    return pl.pallas_call(
+        _hard_kernel,
+        out_shape=jax.ShapeDtypeStruct((rows, n_tiles * tile), DTYPE),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )
+
+
+def hard_exp(m, interpret: bool = False):
+    """Final-exp hard part for a CYCLOTOMIC fq12 element m — one launch.
+
+    Drop-in for the hard half of pairing.final_exponentiation_fast (the
+    five `_cyclo_pow_x` chains + glue); the easy part (which needs the
+    Fermat inverse) stays on the existing paths."""
+    shape = jnp.shape(_leaves(m)[0])
+    batch = shape[:-1]
+    lanes = int(np.prod(batch)) if batch else 1
+    nt = _n_tiles(lanes, TILE)
+    packed = _pack_element(m, lanes, nt * TILE)
+    out = _hard_call(nt, TILE, len(_X_CHAIN_BITS), interpret)(
+        jnp.asarray(_X_CHAIN_BITS),
+        packed,
+        jnp.asarray(K._EMAT),
+        jnp.asarray(K._CONSTS),
+        jnp.asarray(_tower_consts()),
+    )
+    return _unpack_element(out, 12, lanes, shape)

@@ -59,11 +59,15 @@ def shard_batch(tree: Any, mesh: Mesh) -> Any:
     )
 
 
-def sharded_product2_fn(mesh: Mesh):
+def sharded_product2_fn(mesh: Mesh, fused=None):
     """Jitted sharded (P1,Q1,P2,Q2) → fq12 limbs of FE(ML·ML).
 
     Data-parallel over the mesh: XLA partitions the whole pairing graph on
     the batch axis; no cross-chip traffic until the host gathers results.
+    ``fused`` routes each shard's chain onto the VMEM-resident fused
+    tower kernels (ops/pairing_chain.py): pass the resolved mode for a
+    cache-keyed caller, or leave None to consult the env ladder at TRACE
+    time (fine for trace-once callers like the graft entry).
     """
 
     def wrapped(P1, Q1, P2, Q2):
@@ -73,7 +77,7 @@ def sharded_product2_fn(mesh: Mesh):
             ),
             (P1, Q1, P2, Q2),
         )
-        return pairing.product2_fast(*args)
+        return pairing.product2_fast(*args, fused=fused)
 
     return jax.jit(wrapped)
 

@@ -141,6 +141,22 @@ class Counters:
     device_seconds_rs_enc: float = 0.0  # batched GF(2⁸) parity matmuls
     device_seconds_rs_dec: float = 0.0  # batched GF(2⁸) decode matmuls
     device_seconds_merkle: float = 0.0  # batched device SHA-256 (build+verify)
+    # VMEM-resident fused tower chain (PR 20): dispatches whose pairing
+    # graph rode the fused kernels (ops/pairing_chain.py) bill their
+    # device wall here instead of the per-kind buckets above, so the
+    # fused/unfused A/B reads directly off the kind split.
+    device_seconds_fused_chain: float = 0.0
+    # fused-chain accounting: calls that routed onto the fused kernels,
+    # the ANALYTIC Fq-mul count executed inside them (pairing_chain.
+    # analytic_chain_field_muls — the muls/s numerator of the
+    # fused_chain_ab bench row), and the analytic per-verification device
+    # kernel-launch counts of both compositions (pairing_chain.
+    # analytic_pallas_calls — the ≥3× dispatch-drop criterion reads off
+    # fused vs stacked directly).
+    fused_tower_calls: int = 0
+    fused_chain_field_muls: int = 0
+    fused_chain_pallas_calls: int = 0
+    stacked_chain_pallas_calls: int = 0
 
     def snapshot(self) -> Dict[str, float]:
         return asdict(self)

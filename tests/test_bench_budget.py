@@ -105,6 +105,22 @@ def test_rs_plane_ab_planned_both_modes(bench):
     assert "rs_plane_ab" in bench._BENCH_EST_S
 
 
+def test_fused_chain_ab_planned_both_modes(bench):
+    """The VMEM-resident fused tower chain A/B row (PR 20) rides both
+    orderings: a diagnostic, so it stays behind the flagship prefix
+    under a budget — but directly behind it (ahead of glv_ladder and
+    every support row), so a timeout-killed window still captures the
+    device-chain A/B — with a cost estimate."""
+    for budget in (0.0, 3000.0):
+        names = [n for n, _ in bench._plan_benches(None, "tpu", budget)]
+        assert "fused_chain_ab" in names
+    budgeted = [n for n, _ in bench._plan_benches(None, "tpu", 3000.0)]
+    assert budgeted.index("array_n100_tpu") < budgeted.index("fused_chain_ab")
+    assert budgeted.index("fused_chain_ab") < budgeted.index("glv_ladder")
+    assert budgeted.index("fused_chain_ab") < budgeted.index("rs_encode")
+    assert "fused_chain_ab" in bench._BENCH_EST_S
+
+
 def test_n100_tpu_gating(bench):
     # off-TPU driver runs never attempt the real-crypto N=100 row...
     assert "array_n100_tpu" not in [

@@ -99,6 +99,14 @@ def test_glv_ab_bench_kind_registered():
     assert "glv_ab" in _counters_kinds()
 
 
+def test_fused_chain_kind_registered():
+    """Verification dispatches routed onto the VMEM-resident fused tower
+    chain (PR 20) bill under kind="fused_chain" so the fused/unfused A/B
+    reads directly off the per-kind device-seconds split — the kind must
+    exist as a Counters field or those dispatches would be unkinded."""
+    assert "fused_chain" in _counters_kinds()
+
+
 def test_device_rs_plane_kinds_registered():
     """The device erasure/hash plane (PR 19) dispatches RS encode,
     RS decode, and Merkle build/verify chunks under their own kinds so

@@ -19,7 +19,25 @@ import pytest
 jnp = pytest.importorskip("jax.numpy")
 
 import jax
-from jax import shard_map
+
+# jax moved shard_map to the top level only in later releases; the image's
+# jax still ships it under jax.experimental.
+try:
+    from jax import shard_map as _shard_map
+
+    _NO_CHECK = {"check_vma": False}
+except ImportError:  # pragma: no cover - version-dependent import path
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    # older releases call the same escape hatch check_rep
+    _NO_CHECK = {"check_rep": False}
+
+
+def shard_map(f, **kw):
+    if "check_vma" in kw:
+        kw.pop("check_vma")
+        kw.update(_NO_CHECK)
+    return _shard_map(f, **kw)
 from jax.sharding import Mesh, PartitionSpec as P
 
 from hbbft_tpu.crypto.field import Q

@@ -2232,3 +2232,66 @@ def test_lint_json_stable_sort_and_stdout_mode(tmp_path):
         ("a.py", 1), ("a.py", 2), ("b.py", 9)
     ]
     _json.dumps(doc)  # serializable
+
+
+# ---------------------------------------------------------------------------
+# fused tower chain scopes (PR 20): ops/tower_fused.py + ops/pairing_chain.py
+# ---------------------------------------------------------------------------
+
+
+def test_deferred_fetch_covers_fused_tower_modules():
+    """The fused kernels/orchestration run INSIDE backend dispatch graphs;
+    a host fetch there stalls every fused_chain/rlc dispatch mid-trace."""
+    from hbbft_tpu.analysis.rules_tracer import DeferredFetchRule
+
+    src = """\
+    import numpy as np
+
+    def peek_carry(rows):
+        return np.asarray(rows)
+    """
+    rule = DeferredFetchRule()
+    for path in (
+        "hbbft_tpu/ops/tower_fused.py",
+        "hbbft_tpu/ops/pairing_chain.py",
+    ):
+        assert rule.applies_to(path)
+        findings = lint_sources(DeferredFetchRule(), {path: src})
+        assert len(findings) == 1, path
+        assert "np.asarray" in findings[0].message
+
+
+def test_seam_race_covers_fused_tower_modules():
+    """Scope registration plus a seeded violation: module-level routing
+    state shared between a submit-side helper and a delivery callback is
+    exactly the crossing the rule inventories."""
+    assert "hbbft_tpu/ops/tower_fused.py" in SeamRaceRule.scope
+    assert "hbbft_tpu/ops/pairing_chain.py" in SeamRaceRule.scope
+    findings = lint_sources(
+        SeamRaceRule(),
+        {
+            "hbbft_tpu/ops/pairing_chain.py": """\
+            class ChainRouter:
+                def __init__(self):
+                    self.mode_latch = None
+
+                def _submit_chain(self, pipe, items):
+                    self.mode_latch = "native"
+                    pipe.submit(items)
+
+                def _resolve_chain(self, res):
+                    return self.mode_latch
+            """
+        },
+    )
+    assert len(findings) == 1
+    assert "self.mode_latch" in findings[0].message
+
+
+def test_tracer_safety_covers_fused_tower_modules():
+    """ops/ is already in TracerSafetyRule scope as a directory — pin that
+    the new modules resolve under it (a scope refactor that enumerates
+    files must not drop them)."""
+    rule = TracerSafetyRule()
+    assert rule.applies_to("hbbft_tpu/ops/tower_fused.py")
+    assert rule.applies_to("hbbft_tpu/ops/pairing_chain.py")

@@ -6,6 +6,10 @@ randomly drawn adversaries and seeds, and asserts the consensus invariants.
 Hypothesis shrinks failures to minimal dimensions, like proptest.
 """
 
+import pytest
+
+pytest.importorskip("hypothesis", reason="hypothesis not installed in this image")
+
 from hypothesis import HealthCheck, given, settings, strategies as st
 
 from hbbft_tpu.crypto.backend import MockBackend

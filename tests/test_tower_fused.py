@@ -1,0 +1,268 @@
+"""Fused VMEM-resident tower chain (PR 20): goldens, routing, kill switch.
+
+Every fused kernel reuses the exact recombination code of ops/tower.py on
+top of the `fq_rns_pallas` Montgomery core, so the acceptance bar is
+BIT-IDENTICAL represented values (canonical readback via
+``tower.*_to_ints``), not approximate agreement:
+
+* interpret-mode op goldens — fq2/fq6/fq12 mul+sqr and the cyclotomic
+  square against the stacked tower ops on the same inputs;
+* the fused Miller loop and the whole fused verification graph
+  (`product2_fast_fused`) against `pairing.product2_fast`, including the
+  degenerate infinity-lane arm (mirroring test_glv_degenerate's adversarial
+  route probes);
+* the backend kill-switch A/B: HBBFT_TPU_NO_FUSED_TOWER must restore the
+  unfused graphs exactly — identical verdicts, identical
+  ``device_dispatches``, and counter non-leak in BOTH directions;
+* the analytic dispatch model: ≥3× fewer Pallas launches per verification
+  graph (the ISSUE 20 acceptance bar).
+
+All kernels run with TILE=8 in interpret mode (no Mosaic on CPU); the
+lru-cached pallas_call factories key on (tile, interpret) so the patched
+tile never leaks into other modules.
+"""
+
+import os
+import random
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from hbbft_tpu.crypto.field import Q
+from hbbft_tpu.ops import fq, pairing, tower
+
+pytestmark = pytest.mark.skipif(
+    fq.IMPL != "rns", reason="fused tower kernels bind to the RNS field impl"
+)
+
+import hbbft_tpu.ops.pairing_chain as pc  # noqa: E402
+import hbbft_tpu.ops.tower_fused as tf  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _small_tile(monkeypatch):
+    monkeypatch.setattr(tf, "TILE", 8)
+
+
+@pytest.fixture(scope="module")
+def rng():
+    return random.Random(2020)
+
+
+def _rnd_fq2(rng):
+    return (rng.randrange(Q), rng.randrange(Q))
+
+
+def _rnd_fq6(rng):
+    return tuple(_rnd_fq2(rng) for _ in range(3))
+
+
+def _rnd_fq12(rng):
+    return tuple(_rnd_fq6(rng) for _ in range(2))
+
+
+def test_fused_ops_bit_identical_to_stacked_tower(rng):
+    """fq2/fq6/fq12 mul+sqr and cyclo-sqr: the single-kernel fused ops
+    must reproduce the stacked tower ops bit-for-bit on canonical
+    readback (same recombination code, same Montgomery core)."""
+    n = 8
+    x2 = [_rnd_fq2(rng) for _ in range(n)]
+    y2 = [_rnd_fq2(rng) for _ in range(n)]
+    a2, b2 = tower.fq2_stack(x2), tower.fq2_stack(y2)
+    got = tf.fq2_mul(a2, b2, interpret=True)
+    want = tower.fq2_mul(a2, b2)
+    for i in range(n):
+        assert tower.fq2_to_ints(got, i) == tower.fq2_to_ints(want, i)
+    got = tf.fq2_sqr(a2, interpret=True)
+    want = tower.fq2_sqr(a2)
+    for i in range(n):
+        assert tower.fq2_to_ints(got, i) == tower.fq2_to_ints(want, i)
+
+    x6 = [_rnd_fq6(rng) for _ in range(n)]
+    y6 = [_rnd_fq6(rng) for _ in range(n)]
+    a6, b6 = tower.fq6_stack(x6), tower.fq6_stack(y6)
+    got = tf.fq6_mul(a6, b6, interpret=True)
+    want = tower.fq6_mul(a6, b6)
+    for i in range(n):
+        assert tower.fq6_to_ints(got, i) == tower.fq6_to_ints(want, i)
+    got = tf.fq6_sqr(a6, interpret=True)
+    want = tower.fq6_sqr(a6)
+    for i in range(n):
+        assert tower.fq6_to_ints(got, i) == tower.fq6_to_ints(want, i)
+
+    x12 = [_rnd_fq12(rng) for _ in range(n)]
+    y12 = [_rnd_fq12(rng) for _ in range(n)]
+    a12, b12 = tower.fq12_stack(x12), tower.fq12_stack(y12)
+    for fused_fn, stacked_fn, args in (
+        (tf.fq12_mul, tower.fq12_mul, (a12, b12)),
+        (tf.fq12_sqr, tower.fq12_sqr, (a12,)),
+        (tf.fq12_cyclo_sqr, tower.fq12_cyclo_sqr, (a12,)),
+    ):
+        got = fused_fn(*args, interpret=True)
+        want = stacked_fn(*args)
+        for i in range(n):
+            assert tower.fq12_to_ints(got, i) == tower.fq12_to_ints(want, i)
+
+
+def test_fused_miller_loop_bit_identical(rng):
+    n = 2
+    P1, Q1, _, _ = pairing.example_verify_batch(n, seed=5, distinct=n)
+    got = pc.miller_loop_fused(P1, Q1, mode="interpret")
+    want = pairing.miller_loop(P1, Q1)
+    assert tower.fq12_to_ints_batch(got, n) == tower.fq12_to_ints_batch(want, n)
+
+
+def test_fused_product2_bit_identical_and_verdicts(rng):
+    """The whole fused verification graph (merged Miller + fused hard
+    part) against the stacked graph, plus the pairing verdicts the
+    backend actually consumes — and the analytic ≥3× dispatch drop."""
+    n = 2
+    P1, Q1, P2, Q2 = pairing.example_verify_batch(n, seed=0, distinct=n)
+    got = pc.product2_fast_fused(P1, Q1, P2, Q2, mode="interpret")
+    want = pairing.product2_fast(P1, Q1, P2, Q2)
+    assert tower.fq12_to_ints_batch(got, n) == tower.fq12_to_ints_batch(want, n)
+    assert all(pairing.is_one_host_batch(got, n))
+    # the routed entry point reaches the same graph
+    via_route = pairing.product2_fast(P1, Q1, P2, Q2, fused="interpret")
+    assert tower.fq12_to_ints_batch(via_route, n) == tower.fq12_to_ints_batch(
+        want, n
+    )
+    ratio = pc.analytic_pallas_calls(2, fused=False) / pc.analytic_pallas_calls(
+        2, fused=True
+    )
+    assert ratio >= 3.0, f"fused chain saves only {ratio:.2f}x launches"
+
+
+def test_fused_product2_degenerate_infinity_lanes(rng):
+    """Infinity lanes (mirroring test_glv_degenerate's adversarial route
+    probes): the fused graph must route the neutral-select exactly like
+    the stacked one — a lane with P or Q at infinity contributes the
+    identity, wherever the infinity flag lands.  Deliberately the SAME
+    n=2 batch shape as the golden test above: the degenerate arm rides
+    the already-compiled graphs (compile-budget discipline, PERF.md
+    round 16) — only the infinity flags differ."""
+    n = 2
+    P1, Q1, P2, Q2 = pairing.example_verify_batch(n, seed=0, distinct=n)
+
+    def with_inf(T, lanes):
+        x, y, inf = T
+        mask = np.zeros(np.shape(inf), dtype=bool)
+        for i in lanes:
+            mask[i] = True
+        return (x, y, jnp.asarray(np.asarray(inf) | mask))
+
+    P1d = with_inf(P1, [0])  # pair-1 P at infinity on lane 0
+    Q2d = with_inf(Q2, [0])  # pair-2 Q at infinity on lane 0
+    got = pc.product2_fast_fused(P1d, Q1, P2, Q2d, mode="interpret")
+    want = pairing.product2_fast(P1d, Q1, P2, Q2d)
+    assert tower.fq12_to_ints_batch(got, n) == tower.fq12_to_ints_batch(want, n)
+    # lane 1 is an untouched valid verification lane → still one; lane 0
+    # degenerates BOTH pairs to the identity, so the product is one too
+    assert all(pairing.is_one_host_batch(got, n))
+
+
+def _backend_arm(monkeypatch, kill: bool):
+    from hbbft_tpu.ops.backend import TpuBackend
+
+    monkeypatch.setenv("HBBFT_TPU_FUSED_TOWER", "interpret")
+    if kill:
+        monkeypatch.setenv("HBBFT_TPU_NO_FUSED_TOWER", "1")
+    else:
+        monkeypatch.delenv("HBBFT_TPU_NO_FUSED_TOWER", raising=False)
+    rng = random.Random(2024)
+    be = TpuBackend()
+    sks = be.generate_key_set(1, rng)
+    pks = sks.public_keys()
+    doc = b"pr20-fused-ab"
+    items = []
+    for i in range(3):
+        items.append((pks.public_key_share(i), doc, sks.secret_key_share(i).sign_share(doc)))
+    # one invalid item: pk/share index mismatch
+    items.append((pks.public_key_share(1), doc, sks.secret_key_share(0).sign_share(doc)))
+    verdicts = be.verify_sig_shares(items)
+    return verdicts, be.counters.snapshot()
+
+
+@pytest.mark.slow
+def test_backend_kill_switch_ab(monkeypatch):
+    """HBBFT_TPU_NO_FUSED_TOWER restores the unfused graphs exactly:
+    identical verdicts, identical device_dispatches, and counter
+    non-leak in BOTH directions (fused counters stay zero under the kill
+    switch; the stacked launch counter stays zero on the fused arm).
+    Slow: two full rlc_sig graph compiles (fused + stacked) on XLA:CPU."""
+    fused_v, fused_c = _backend_arm(monkeypatch, kill=False)
+    kill_v, kill_c = _backend_arm(monkeypatch, kill=True)
+
+    assert fused_v == kill_v == [True, True, True, False]
+    assert fused_c["device_dispatches"] == kill_c["device_dispatches"]
+
+    assert fused_c["fused_tower_calls"] > 0
+    assert fused_c["fused_chain_pallas_calls"] > 0
+    assert fused_c["fused_chain_field_muls"] > 0
+    # this small batch rides exact pairing checks → kind "fused_chain"
+    assert fused_c["device_seconds_fused_chain"] > 0.0
+    assert fused_c["stacked_chain_pallas_calls"] == 0
+
+    assert kill_c["fused_tower_calls"] == 0
+    assert kill_c["fused_chain_pallas_calls"] == 0
+    assert kill_c["fused_chain_field_muls"] == 0
+    assert kill_c["device_seconds_fused_chain"] == 0.0
+    assert kill_c["stacked_chain_pallas_calls"] > 0
+
+
+def test_mode_ladder_and_kill_switch_env(monkeypatch):
+    """fused_tower_mode honours every rung of the fallback ladder."""
+    for var in (
+        "HBBFT_TPU_NO_PALLAS",
+        "HBBFT_TPU_NO_FUSED",
+        "HBBFT_TPU_NO_FUSED_TOWER",
+        "HBBFT_TPU_FUSED_TOWER",
+    ):
+        monkeypatch.delenv(var, raising=False)
+    monkeypatch.setenv("HBBFT_TPU_FUSED_TOWER", "interpret")
+    assert tf.fused_tower_mode() == "interpret"
+    monkeypatch.setenv("HBBFT_TPU_NO_FUSED_TOWER", "1")
+    assert tf.fused_tower_mode() is None  # per-call kill switch wins
+    monkeypatch.delenv("HBBFT_TPU_NO_FUSED_TOWER", raising=False)
+    for ladder_var in ("HBBFT_TPU_NO_FUSED", "HBBFT_TPU_NO_PALLAS"):
+        monkeypatch.setenv(ladder_var, "1")
+        assert tf.fused_tower_mode() is None  # inherited fallback rungs
+        monkeypatch.delenv(ladder_var, raising=False)
+    monkeypatch.setenv("HBBFT_TPU_FUSED_TOWER", "0")
+    assert tf.fused_tower_mode() is None
+    # resolve_mode: explicit override beats the env ladder
+    monkeypatch.setenv("HBBFT_TPU_FUSED_TOWER", "interpret")
+    assert pc.resolve_mode(False) is None
+    assert pc.resolve_mode("native") == "native"
+    assert pc.resolve_mode(None) == "interpret"
+
+
+@pytest.mark.slow
+def test_n16_engine_ab_batches_identical(monkeypatch):
+    """N=16 real-crypto engine epoch, fused arm vs kill-switch arm:
+    Batches bit-identical, device_dispatches identical, fused counters
+    light up only on the fused arm (the ISSUE 20 engine-level A/B)."""
+    from hbbft_tpu.engine import ArrayHoneyBadgerNet
+    from hbbft_tpu.ops.backend import TpuBackend
+
+    def arm(kill):
+        monkeypatch.setenv("HBBFT_TPU_FUSED_TOWER", "interpret")
+        if kill:
+            monkeypatch.setenv("HBBFT_TPU_NO_FUSED_TOWER", "1")
+        else:
+            monkeypatch.delenv("HBBFT_TPU_NO_FUSED_TOWER", raising=False)
+        be = TpuBackend()
+        net = ArrayHoneyBadgerNet(range(16), backend=be, seed=0, coin_rounds=1)
+        batches = net.run_epochs(1, payload_size=64)
+        return batches, be.counters.snapshot()
+
+    fused_b, fused_c = arm(False)
+    kill_b, kill_c = arm(True)
+    assert fused_b == kill_b, "fused chain changed Batch outputs"
+    assert fused_c["device_dispatches"] == kill_c["device_dispatches"]
+    assert fused_c["fused_tower_calls"] > 0
+    assert kill_c["fused_tower_calls"] == 0
+    assert kill_c["fused_chain_pallas_calls"] == 0
+    assert fused_c["stacked_chain_pallas_calls"] == 0

@@ -21,7 +21,8 @@ _ALL_STEPS = [
     "n100", "matrix_rns_a", "matrix_limb_a", "matrix_rns_b", "matrix_limb_b",
     "glv_ab", "host_ab", "adv_matrix", "qhb_traffic", "slo_traffic",
     "crash_matrix", "mesh_scaling", "n16_churn", "flips10k", "kernel_levers",
-    "driver_budget", "rs_ab", "rs_plane", "n32_churn", "n64coin", "n100_churn",
+    "driver_budget", "rs_ab", "rs_plane", "fused_chain", "n32_churn",
+    "n64coin", "n100_churn",
 ]
 
 

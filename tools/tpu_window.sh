@@ -116,6 +116,9 @@ done_rs_ab() {
 done_rs_plane() {
   has_row "$ART/rows_after_rs_plane.json" rs_plane_ab
 }
+done_fused_chain() {
+  has_row "$ART/rows_after_fused_chain.json" fused_chain_ab
+}
 done_kernel_levers() {
   # completion marker written at the END of the step: a mid-step death
   # must re-run it (the first sub-command already prints fused-chain
@@ -222,6 +225,15 @@ do_rs_plane() {
   # N=16 and the N=100 f=33 shapes.  Cheap kernel row; the measurement
   # protocol (bucket-fold acceptance) is PERF.md round 15.
   HBBFT_TPU_FQ_IMPL=rns BENCH_ONLY=rs_plane_ab timeout 1800 python bench.py
+}
+do_fused_chain() {
+  # VMEM-resident fused tower chain A/B (PR 20): the grouped rlc_sig
+  # verification graph on the fused Miller/hard-exp kernels vs the
+  # stacked composition (_jitted_rlc_sig(mode), in-process A/B with
+  # bit-identical readback asserted).  The row's value is analytic
+  # field-muls/s inside the fused kernels — the ≥2G north-star reads
+  # off it directly; measurement protocol is PERF.md round 16.
+  HBBFT_TPU_FQ_IMPL=rns BENCH_ONLY=fused_chain_ab timeout 1800 python bench.py
 }
 do_kernel_levers() {
   # body runs under -e/pipefail so a failed sub-command (timeout rc=124,
@@ -365,7 +377,7 @@ do_n100_churn() {
     timeout 18000 python bench.py
 }
 
-STEPS="n100 matrix_rns_a matrix_limb_a matrix_rns_b matrix_limb_b glv_ab host_ab adv_matrix qhb_traffic slo_traffic crash_matrix mesh_scaling n16_churn flips10k kernel_levers driver_budget rs_ab rs_plane n32_churn n64coin n100_churn"
+STEPS="n100 matrix_rns_a matrix_limb_a matrix_rns_b matrix_limb_b glv_ab host_ab adv_matrix qhb_traffic slo_traffic crash_matrix mesh_scaling n16_churn flips10k kernel_levers driver_budget rs_ab rs_plane fused_chain n32_churn n64coin n100_churn"
 
 for s in $STEPS; do
   if "done_$s"; then
